@@ -1,0 +1,68 @@
+"""sparktorch_tpu — a TPU-native distributed training framework.
+
+A ground-up re-design of the capability surface of ``sparktorch``
+(reference: ``/root/reference/sparktorch/__init__.py:1-4`` exports
+``serialize_torch_obj``, ``serialize_torch_obj_lazy``, ``SparkTorch``,
+``PysparkPipelineWrapper``, ``create_spark_torch_model``) built on
+JAX/XLA/Pallas for TPU pods instead of PyTorch/gloo/Spark-JVM.
+
+Architecture (TPU-first, not a port):
+
+- The reference's "one gloo rank per Spark executor" data parallelism
+  (``distributed.py:180-182`` per-parameter all_reduce loop) becomes a
+  single jitted SPMD train step over a ``jax.sharding.Mesh``; gradient
+  synchronisation is a weighted global mean that XLA lowers to ICI
+  collectives — zero per-step Python on the hot path.
+- The reference's Flask parameter server (``server.py``) becomes an
+  HBM-resident parameter service with versioned pulls and a
+  single-writer jitted apply queue (``sparktorch_tpu.serve``).
+- The Spark ML ``Estimator``/``Transformer``/``Pipeline`` surface
+  (``torch_distributed.py:130-349``) is provided natively (no JVM) by
+  ``sparktorch_tpu.ml``, with an optional PySpark adapter.
+"""
+
+from sparktorch_tpu.utils.serde import (
+    ModelSpec,
+    serialize_model,
+    serialize_model_lazy,
+    deserialize_model,
+    # Reference-compatible aliases (sparktorch/__init__.py:1-4).
+    serialize_torch_obj,
+    serialize_torch_obj_lazy,
+)
+from sparktorch_tpu.utils.data import DataBatch, handle_features
+from sparktorch_tpu.utils.early_stopper import EarlyStopping
+from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+from sparktorch_tpu.ml.estimator import SparkTorch, SparkTorchModel
+from sparktorch_tpu.ml.pipeline import Pipeline, PipelineModel, PysparkPipelineWrapper
+from sparktorch_tpu.inference import (
+    create_spark_torch_model,
+    attach_model_to_pipeline,
+    attach_pytorch_model_to_pipeline,
+    convert_to_serialized,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ModelSpec",
+    "serialize_model",
+    "serialize_model_lazy",
+    "deserialize_model",
+    "serialize_torch_obj",
+    "serialize_torch_obj_lazy",
+    "DataBatch",
+    "handle_features",
+    "EarlyStopping",
+    "MeshConfig",
+    "build_mesh",
+    "SparkTorch",
+    "SparkTorchModel",
+    "Pipeline",
+    "PipelineModel",
+    "PysparkPipelineWrapper",
+    "create_spark_torch_model",
+    "attach_model_to_pipeline",
+    "attach_pytorch_model_to_pipeline",
+    "convert_to_serialized",
+]

@@ -1,0 +1,77 @@
+"""Wrap already-trained models for batch inference.
+
+Reference: ``sparktorch/inference.py`` —
+``convert_to_serialized_torch`` (:8-15), ``create_spark_torch_model``
+(:18-39), ``attach_pytorch_model_to_pipeline`` (:42-61).
+
+Here a "trained model" is a Flax module + trained variables; the
+wrapped :class:`SparkTorchModel` runs the compiled chunked forward
+(no per-row UDF).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from sparktorch_tpu.ml.estimator import SparkTorchModel, _encode_bundle
+from sparktorch_tpu.ml.pipeline import PipelineModel
+from sparktorch_tpu.utils.serde import ModelSpec
+
+
+def _bundle_spec(model: Any, variables: Optional[dict], loss: str = "mse"):
+    if variables is None:
+        raise ValueError(
+            "pass trained variables (the dict returned by module.init/"
+            "training) — Flax modules carry no weights"
+        )
+    variables = dict(variables)
+    params = variables.pop("params", variables)
+    spec = ModelSpec(module=model, loss=loss)
+    return spec, params, variables
+
+
+def convert_to_serialized(model: Any, variables: dict) -> str:
+    """Serialize a trained (module, variables) pair to the model
+    string format used by :class:`SparkTorchModel`.
+
+    Parity: ``convert_to_serialized_torch`` (inference.py:8-15).
+    """
+    spec, params, model_state = _bundle_spec(model, variables)
+    return _encode_bundle(spec, params, model_state)
+
+
+def create_spark_torch_model(
+    model: Any,
+    variables: Optional[dict] = None,
+    inputCol: str = "features",
+    predictionCol: str = "predicted",
+    useVectorOut: bool = False,
+) -> SparkTorchModel:
+    """Wrap a trained model as a transformer without running ``fit``.
+
+    Parity: ``create_spark_torch_model`` (inference.py:18-39).
+    """
+    spec, params, model_state = _bundle_spec(model, variables)
+    return SparkTorchModel(
+        inputCol=inputCol,
+        predictionCol=predictionCol,
+        modStr=_encode_bundle(spec, params, model_state),
+        useVectorOut=useVectorOut,
+    )
+
+
+def attach_model_to_pipeline(
+    pipeline_model: PipelineModel,
+    spark_model: SparkTorchModel,
+) -> PipelineModel:
+    """Append an inference stage to a fitted pipeline.
+
+    Parity: ``attach_pytorch_model_to_pipeline`` (inference.py:42-61).
+    """
+    return PipelineModel(list(pipeline_model.stages) + [spark_model])
+
+
+# Reference-compatible name.
+attach_pytorch_model_to_pipeline = attach_model_to_pipeline

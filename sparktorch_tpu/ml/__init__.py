@@ -1,0 +1,28 @@
+from sparktorch_tpu.ml.params import (
+    Param,
+    Params,
+    TypeConverters,
+    Estimator,
+    Transformer,
+    Model,
+    keyword_only,
+)
+from sparktorch_tpu.ml.dataset import LocalDataFrame
+from sparktorch_tpu.ml.estimator import SparkTorch, SparkTorchModel
+from sparktorch_tpu.ml.pipeline import Pipeline, PipelineModel, PysparkPipelineWrapper
+
+__all__ = [
+    "Param",
+    "Params",
+    "TypeConverters",
+    "Estimator",
+    "Transformer",
+    "Model",
+    "keyword_only",
+    "LocalDataFrame",
+    "SparkTorch",
+    "SparkTorchModel",
+    "Pipeline",
+    "PipelineModel",
+    "PysparkPipelineWrapper",
+]

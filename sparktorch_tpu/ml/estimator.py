@@ -1,0 +1,353 @@
+"""``SparkTorch`` Estimator / ``SparkTorchModel`` Transformer.
+
+Reference: ``sparktorch/torch_distributed.py:130-349`` (Estimator with
+14 declared Params + 3 column params; ``_fit`` dispatches to the sync
+or hogwild trainer) and ``:58-127`` (Model with row-wise UDF inference).
+
+The Param surface is kept name-for-name — torchObj, mode, device,
+iters, partitions, verbose, acquireLock, partitionShuffles, port,
+useBarrier, useVectorOut, earlyStopPatience, miniBatch, validationPct
+(``torch_distributed.py:141-154``) — so reference users can port their
+configs unchanged. TPU-native differences:
+
+- ``device`` and ``partitions`` are accepted but the mesh defines
+  placement and world size; ``useBarrier`` is accepted and always
+  effectively true (SPMD *is* gang execution).
+- Inference is a batched compiled forward over the whole column in
+  fixed-size padded chunks (one XLA program, reused), not a batch-1
+  Python UDF per row (``torch_distributed.py:106-120``).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, NamedTuple, Optional
+
+import dill
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparktorch_tpu.ml.dataset import LocalDataFrame
+from sparktorch_tpu.ml.params import (
+    Estimator,
+    Model,
+    Param,
+    Params,
+    TypeConverters,
+    keyword_only,
+)
+from sparktorch_tpu.utils.serde import ModelSpec, deserialize_model
+
+_INFER_CHUNK = 1024  # static chunk so XLA compiles one forward program
+
+
+class ModelBundle(NamedTuple):
+    """What ``getPytorchModel`` returns here: the module + trained
+    variables (the reference returns a torch ``nn.Module``,
+    ``torch_distributed.py:92-94``)."""
+
+    module: Any
+    params: Any
+    model_state: Any
+
+    def apply(self, x):
+        variables = {"params": self.params, **(self.model_state or {})}
+        return self.module.apply(variables, x)
+
+
+def _encode_bundle(spec: ModelSpec, params, model_state) -> str:
+    payload = {"spec": spec, "params": params, "model_state": model_state}
+    return base64.b64encode(dill.dumps(payload)).decode()
+
+
+def _decode_bundle(mod_str: str) -> dict:
+    return dill.loads(base64.b64decode(mod_str))
+
+
+class SparkTorchModel(Model):
+    """Fitted model; ``transform`` adds a prediction column.
+
+    Params parity: ``modStr`` + ``useVectorOut``
+    (``torch_distributed.py:60-61``) plus inherited input/prediction
+    cols.
+    """
+
+    modStr = Param(Params._dummy(), "modStr", "serialized trained model",
+                   TypeConverters.toString)
+    useVectorOut = Param(Params._dummy(), "useVectorOut",
+                         "emit the raw output vector instead of argmax/scalar",
+                         TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, inputCol=None, predictionCol=None, modStr=None,
+                 useVectorOut=None):
+        super().__init__()
+        self._setDefault(predictionCol="predictions", useVectorOut=False)
+        self._set(**self._input_kwargs)
+        self._bundle_cache = None
+        self._forward_cache = None
+
+    def getModStr(self) -> str:
+        return self.getOrDefault(self.modStr)
+
+    def getUseVectorOut(self) -> bool:
+        return self.getOrDefault(self.useVectorOut)
+
+    # Reference name (torch_distributed.py:92-94) + idiomatic alias.
+    def getPytorchModel(self) -> ModelBundle:
+        return self.getModel()
+
+    def getModel(self) -> ModelBundle:
+        if self._bundle_cache is None:
+            payload = _decode_bundle(self.getModStr())
+            spec: ModelSpec = payload["spec"]
+            self._bundle_cache = ModelBundle(
+                module=spec.make_module(),
+                params=payload["params"],
+                model_state=payload["model_state"],
+            )
+        return self._bundle_cache
+
+    # -- inference ---------------------------------------------------------
+
+    def _forward(self):
+        if self._forward_cache is None:
+            bundle = self.getModel()
+            from sparktorch_tpu.train.step import make_forward_fn
+
+            self._forward_cache = (bundle, make_forward_fn(bundle.module.apply))
+        return self._forward_cache
+
+    def _predict_matrix(self, x: np.ndarray) -> np.ndarray:
+        """Chunked, padded, compiled batch inference — replaces the
+        per-row UDF hot loop (``torch_distributed.py:112-120``)."""
+        bundle, fwd = self._forward()
+        n = x.shape[0]
+        outs = []
+        for start in range(0, n, _INFER_CHUNK):
+            chunk = x[start : start + _INFER_CHUNK]
+            real = chunk.shape[0]
+            if real < _INFER_CHUNK and n > _INFER_CHUNK:
+                pad = np.zeros((_INFER_CHUNK - real, *chunk.shape[1:]), chunk.dtype)
+                chunk = np.concatenate([chunk, pad])
+            out = np.asarray(fwd(bundle.params, bundle.model_state, jnp.asarray(chunk)))
+            outs.append(out[:real])
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
+    def _transform(self, dataset):
+        df = LocalDataFrame.from_any(dataset)
+        inp = self.getInputCol()
+        out_col = self.getPredictionCol()
+        x = df.column_matrix(inp)
+        preds = self._predict_matrix(x)
+
+        if self.getUseVectorOut():
+            values = np.empty(len(df), dtype=object)
+            for i in range(len(df)):
+                values[i] = np.asarray(preds[i])
+            return df.with_column(out_col, values)
+
+        # Float path: argmax for multi-output, scalar otherwise
+        # (predict_float, torch_distributed.py:112-120).
+        flat = preds.reshape(preds.shape[0], -1)
+        if flat.shape[1] > 1:
+            values = np.argmax(flat, axis=1).astype(np.float64)
+        else:
+            values = flat[:, 0].astype(np.float64)
+        return df.with_column(out_col, values)
+
+
+class SparkTorch(Estimator):
+    """The flagship Estimator (``torch_distributed.py:130-349``)."""
+
+    torchObj = Param(Params._dummy(), "torchObj", "serialized model spec envelope",
+                     TypeConverters.toString)
+    mode = Param(Params._dummy(), "mode",
+                 "training mode: synchronous | hogwild", TypeConverters.toString)
+    device = Param(Params._dummy(), "device",
+                   "accepted for parity; the mesh decides placement",
+                   TypeConverters.toString)
+    iters = Param(Params._dummy(), "iters", "training iterations per shuffle round",
+                  TypeConverters.toInt)
+    partitions = Param(Params._dummy(), "partitions",
+                       "data partition hint (mesh decides sharding)",
+                       TypeConverters.toInt)
+    verbose = Param(Params._dummy(), "verbose", "loss logging verbosity",
+                    TypeConverters.toInt)
+    acquireLock = Param(Params._dummy(), "acquireLock",
+                        "serialize async server applies", TypeConverters.toBoolean)
+    partitionShuffles = Param(Params._dummy(), "partitionShuffles",
+                              "global reshuffle rounds", TypeConverters.toInt)
+    port = Param(Params._dummy(), "port", "param-server port (async mode)",
+                 TypeConverters.toInt)
+    useBarrier = Param(Params._dummy(), "useBarrier",
+                       "gang scheduling (always true under SPMD)",
+                       TypeConverters.toBoolean)
+    useVectorOut = Param(Params._dummy(), "useVectorOut",
+                         "fitted model emits raw output vectors",
+                         TypeConverters.toBoolean)
+    earlyStopPatience = Param(Params._dummy(), "earlyStopPatience",
+                              "early-stop patience (-1 disables)",
+                              TypeConverters.toInt)
+    miniBatch = Param(Params._dummy(), "miniBatch",
+                      "global minibatch size per step (-1 = full batch)",
+                      TypeConverters.toInt)
+    validationPct = Param(Params._dummy(), "validationPct",
+                          "validation split fraction", TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, labelCol=None, predictionCol=None,
+                 torchObj=None, iters=None, partitions=None, verbose=None,
+                 mode=None, device=None, acquireLock=None, partitionShuffles=None,
+                 port=None, useBarrier=None, useVectorOut=None,
+                 earlyStopPatience=None, miniBatch=None, validationPct=None,
+                 mesh=None, seed=None):
+        super().__init__()
+        # Defaults mirror torch_distributed.py:178-196.
+        self._setDefault(
+            predictionCol="predictions",
+            mode="synchronous",
+            device="tpu",
+            iters=10,
+            verbose=0,
+            acquireLock=True,
+            partitionShuffles=1,
+            port=3000,
+            useBarrier=True,
+            useVectorOut=False,
+            earlyStopPatience=-1,
+            miniBatch=-1,
+            validationPct=0.0,
+        )
+        kwargs = dict(self._input_kwargs)
+        self._mesh = kwargs.pop("mesh", None)
+        seed = kwargs.pop("seed", None)
+        self._seed = 0 if seed is None else int(seed)
+        self._set(**kwargs)
+
+    @keyword_only
+    def setParams(self, **kwargs):
+        kwargs = dict(self._input_kwargs)
+        if "mesh" in kwargs:
+            self._mesh = kwargs.pop("mesh")
+        if "seed" in kwargs:
+            seed = kwargs.pop("seed")
+            if seed is not None:
+                self._seed = int(seed)
+        return self._set(**kwargs)
+
+    # -- getters (torch_distributed.py:224-264 parity) ----------------------
+
+    def getTorchObj(self):
+        return self.getOrDefault(self.torchObj)
+
+    def getMode(self):
+        return self.getOrDefault(self.mode)
+
+    def getDevice(self):
+        return self.getOrDefault(self.device)
+
+    def getIters(self):
+        return self.getOrDefault(self.iters)
+
+    def getPartitions(self):
+        return self.getOrDefault(self.partitions) if self.isDefined(self.partitions) else -1
+
+    def getVerbose(self):
+        return self.getOrDefault(self.verbose)
+
+    def getAcquireLock(self):
+        return self.getOrDefault(self.acquireLock)
+
+    def getPartitionShuffles(self):
+        return self.getOrDefault(self.partitionShuffles)
+
+    def getPort(self):
+        return self.getOrDefault(self.port)
+
+    def getUseBarrier(self):
+        return self.getOrDefault(self.useBarrier)
+
+    def getUseVectorOut(self):
+        return self.getOrDefault(self.useVectorOut)
+
+    def getEarlyStopPatience(self):
+        return self.getOrDefault(self.earlyStopPatience)
+
+    def getMiniBatch(self):
+        return self.getOrDefault(self.miniBatch)
+
+    def getValidationPct(self):
+        return self.getOrDefault(self.validationPct)
+
+    # -- fit ----------------------------------------------------------------
+
+    def _extract_xy(self, df: LocalDataFrame):
+        x = df.column_matrix(self.getInputCol())
+        label_col = self.getLabelCol()
+        y = None
+        if label_col is not None and label_col in df.columns:
+            col = df[label_col]
+            if col.dtype == object:
+                y = np.stack([np.asarray(v) for v in col])
+            else:
+                y = np.asarray(col)
+        return x, y
+
+    def _fit(self, dataset) -> SparkTorchModel:
+        df = LocalDataFrame.from_any(dataset)
+        x, y = self._extract_xy(df)
+        spec = deserialize_model(self.getTorchObj())
+
+        mode = self.getMode()
+        mini_batch = self.getMiniBatch()
+        mini_batch = None if mini_batch is None or mini_batch <= 0 else mini_batch
+
+        if mode in ("synchronous", "sync", "barrier"):
+            from sparktorch_tpu.train.sync import train_distributed
+
+            result = train_distributed(
+                spec,
+                x,
+                labels=y,
+                mesh=self._mesh,
+                iters=self.getIters(),
+                partition_shuffles=self.getPartitionShuffles(),
+                verbose=self.getVerbose(),
+                mini_batch=mini_batch,
+                validation_pct=self.getValidationPct(),
+                early_stop_patience=self.getEarlyStopPatience(),
+                seed=self._seed,
+                device=self.getDevice(),
+            )
+        elif mode in ("hogwild", "async"):
+            from sparktorch_tpu.train.hogwild import train_async
+
+            result = train_async(
+                spec,
+                x,
+                labels=y,
+                mesh=self._mesh,
+                iters=self.getIters(),
+                partition_shuffles=self.getPartitionShuffles(),
+                verbose=self.getVerbose(),
+                mini_batch=mini_batch,
+                validation_pct=self.getValidationPct(),
+                early_stop_patience=self.getEarlyStopPatience(),
+                acquire_lock=self.getAcquireLock(),
+                port=self.getPort(),
+                partitions=self.getPartitions(),
+                seed=self._seed,
+            )
+        else:
+            raise ValueError(f"unknown mode {mode!r}; use 'synchronous' or 'hogwild'")
+
+        self._last_metrics = result.metrics
+        mod_str = _encode_bundle(result.spec, result.params, result.model_state)
+        return SparkTorchModel(
+            inputCol=self.getInputCol(),
+            predictionCol=self.getPredictionCol(),
+            modStr=mod_str,
+            useVectorOut=self.getUseVectorOut(),
+        )

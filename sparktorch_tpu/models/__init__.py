@@ -1,0 +1,19 @@
+from sparktorch_tpu.models.simple import (
+    MLP,
+    Net,
+    AutoEncoder,
+    ClassificationNet,
+    NetworkWithParameters,
+    MnistMLP,
+    MnistCNN,
+)
+
+__all__ = [
+    "MLP",
+    "Net",
+    "AutoEncoder",
+    "ClassificationNet",
+    "NetworkWithParameters",
+    "MnistMLP",
+    "MnistCNN",
+]

@@ -1,0 +1,154 @@
+"""Device-mesh construction and sharding vocabulary.
+
+The reference's world model is "gloo rank per Spark executor, world =
+partitions + 1, driver is a phantom rank 0" with TCP rendezvous on a
+hardcoded port (``distributed.py:98-110``; ``torch_distributed.py:305``).
+
+TPU-native replacement: a named :class:`jax.sharding.Mesh` over the
+pod slice. Ranks disappear — parallelism is expressed as sharding
+annotations on one compiled program, and XLA lowers the communication
+onto ICI/DCN. The axes:
+
+- ``dp``   data parallel (the reference's only strategy, §2.4)
+- ``fsdp`` data parallel with parameter sharding (zero-style)
+- ``tp``   tensor/model parallel
+- ``sp``   sequence/context parallel (ring attention rides this axis)
+- ``ep``   expert parallel
+
+Multi-host bring-up goes through :func:`initialize_distributed`
+(PJRT coordinator — the analog of the reference's MASTER_ADDR/PORT
+rendezvous at ``distributed.py:101-105``, minus the phantom rank: the
+driver dispatches, it does not participate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+ALL_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_EP)
+# Axes over which the batch dimension is split (and grads are summed).
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """How to carve the device set into named axes.
+
+    ``dp=None`` means "absorb all devices not claimed by other axes".
+    """
+
+    dp: Optional[int] = None
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> dict:
+        fixed = self.fsdp * self.tp * self.sp * self.ep
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fsdp*tp*sp*ep={fixed}"
+            )
+        dp = self.dp if self.dp is not None else n_devices // fixed
+        total = dp * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.ep} = {total} "
+                f"!= {n_devices} devices"
+            )
+        return {
+            AXIS_DP: dp,
+            AXIS_FSDP: self.fsdp,
+            AXIS_TP: self.tp,
+            AXIS_SP: self.sp,
+            AXIS_EP: self.ep,
+        }
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create the named mesh. Axis order puts ``dp`` outermost so that
+    gradient all-reduces ride contiguous ICI neighborhoods."""
+    config = config or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in ALL_AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, ALL_AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (batch, ...) arrays: batch split over dp+fsdp."""
+    return NamedSharding(mesh, P(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def fsdp_param_sharding(mesh: Mesh, leaf) -> NamedSharding:
+    """Shard a parameter leaf over the fsdp axis along its largest
+    divisible dimension; replicate if nothing divides."""
+    n = mesh.shape[AXIS_FSDP]
+    if n <= 1 or leaf.ndim == 0:
+        return replicated(mesh)
+    dims = sorted(range(leaf.ndim), key=lambda d: -leaf.shape[d])
+    for d in dims:
+        if leaf.shape[d] % n == 0 and leaf.shape[d] >= n:
+            spec = [None] * leaf.ndim
+            spec[d] = AXIS_FSDP
+            return NamedSharding(mesh, P(*spec))
+    return replicated(mesh)
+
+
+def param_shardings(mesh: Mesh, params) -> object:
+    """Pytree of shardings for a param pytree (fsdp-aware)."""
+    return jax.tree.map(lambda leaf: fsdp_param_sharding(mesh, leaf), params)
+
+
+def local_mesh(n: Optional[int] = None, **axes) -> Mesh:
+    """Convenience for tests: mesh over the first ``n`` local devices."""
+    devs = jax.devices()[: (n or len(jax.devices()))]
+    return build_mesh(MeshConfig(**axes), devs)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host PJRT bring-up.
+
+    The analog of the reference's gloo rendezvous
+    (``distributed.py:101-105``): instead of MASTER_ADDR + hardcoded
+    port 3333 + rank=partition_index+1, each host process calls this
+    with a coordinator address; JAX's distributed runtime forms the
+    global device set. Env fallbacks mirror the reference's
+    ``SPARK_LOCAL_IP`` convention (``distributed.py:35-36``).
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "SPARKTORCH_TPU_COORDINATOR"
+    )
+    if coordinator_address is None:
+        return  # single-process mode
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,3 @@
+from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
+
+__all__ = ["ParameterServer", "ParamServerHttp"]

@@ -1,0 +1,312 @@
+"""HBM-resident parameter server for asynchronous (hogwild) training.
+
+Reference: ``sparktorch/server.py`` — a Flask app in a forked process
+on the driver holding the canonical model in shared CPU memory
+(``share_memory()``, server.py:83), with routes ``GET /`` (liveness,
+:89-91), ``GET /parameters`` (full dill state_dict, :93-100),
+``POST /update`` (install grads, ``optimizer.step()`` under an RWLock
+that both read & write paths take as *write*, :125-147), and
+``POST /losses`` (windowed-average early stop, :102-123). It tolerates
+up to 10 update errors before raising (:139-142).
+
+TPU-native redesign:
+
+- Canonical params live as **device arrays in HBM** behind a
+  :class:`VersionedSlot` — reads are lock-free immutable snapshots,
+  so pulls never contend with applies (the reference serializes them,
+  SURVEY §5 "both take the write lock").
+- Applies run on a **single writer thread** draining a FIFO queue
+  through one jitted ``optax`` update — the principled version of
+  hogwild's "just step whenever grads arrive", keeping the optimizer
+  math on-device and race-free by construction.
+- Pulls are **version-tagged**: a client that already holds version N
+  gets "nothing newer" instead of a full redundant weight transfer —
+  eliminating the reference's 2×model-size-per-iteration HTTP
+  pathology (``hogwild.py:103,130``; SURVEY §3.2).
+- Transport is split from state: in-process calls for workers in the
+  same runtime, and a stdlib-HTTP wire (:class:`ParamServerHttp`)
+  with the reference's four routes for remote workers (no Flask in
+  this image; the wire format is dill like the reference's).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+import dill
+import jax
+import numpy as np
+
+from sparktorch_tpu.utils.early_stopper import EarlyStopping
+from sparktorch_tpu.utils.locks import VersionedSlot
+from sparktorch_tpu.utils.serde import ModelSpec, deserialize_model
+
+MAX_TOLERATED_ERRORS = 10  # server.py:139-142 parity
+
+
+class ParameterServer:
+    """Driver-hosted canonical-parameter holder + async applier."""
+
+    def __init__(
+        self,
+        torch_obj,
+        window_len: int = 3,
+        early_stop_patience: int = -1,
+        acquire_lock: bool = True,
+        device: Optional[jax.Device] = None,
+        seed: int = 0,
+    ):
+        # The server deserializes its own model copy, like
+        # server.py:44-51 — but params go straight to device HBM.
+        self.spec: ModelSpec = deserialize_model(torch_obj)
+        self.device = device or jax.devices()[0]
+        self.acquire_lock = acquire_lock  # parity knob; applies are
+        # always serialized by the single writer thread.
+
+        self._tx = self.spec.make_optimizer()
+        rng = jax.random.key(seed)
+        variables = dict(self.spec.init_params(rng))
+        params = variables.pop("params", variables)
+        params = jax.device_put(params, self.device)
+        self._model_state = jax.device_put(variables, self.device)
+        self._opt_state = jax.device_put(self._tx.init(params), self.device)
+        self.slot = VersionedSlot(params)
+
+        # One compiled apply for the life of the server.
+        def _apply(params, opt_state, grads):
+            updates, new_opt = self._tx.update(grads, opt_state, params)
+            import optax
+
+            return optax.apply_updates(params, updates), new_opt
+
+        self._apply_fn = jax.jit(_apply)
+
+        # Windowed early stop (server.py:102-123 parity).
+        self.window_len = max(1, window_len)
+        self._losses: list = []
+        self._stopper = (
+            EarlyStopping(patience=early_stop_patience)
+            if early_stop_patience and early_stop_patience > 0
+            else None
+        )
+        self._stop_flag = False
+        self._loss_lock = threading.Lock()
+
+        self._queue: "queue.Queue" = queue.Queue()
+        self._errors = 0
+        self._failed: Optional[BaseException] = None
+        self._applied = 0
+        self._running = True
+        self._writer = threading.Thread(target=self._apply_loop, daemon=True)
+        self._writer.start()
+
+    # ------------------------------------------------------------------
+    # State access
+    # ------------------------------------------------------------------
+
+    def get_parameters(self, have_version: int = -1) -> Optional[Tuple[int, Any]]:
+        """Immutable snapshot pull; None if the client is up to date.
+
+        Parity: ``GET /parameters`` (server.py:93-100), minus the
+        redundant-transfer pathology.
+        """
+        return self.slot.read_if_newer(have_version)
+
+    def model_state(self):
+        return self._model_state
+
+    @property
+    def applied_updates(self) -> int:
+        return self._applied
+
+    # ------------------------------------------------------------------
+    # Gradient path
+    # ------------------------------------------------------------------
+
+    def push_gradients(self, grads, wait: bool = True,
+                       timeout: float = 60.0) -> None:
+        """Enqueue a gradient pytree for the writer thread.
+
+        Parity: ``POST /update`` (server.py:125-147) — the reference
+        applies ``optimizer.step()`` synchronously inside the request,
+        so a worker's next pull always reflects its own push. With
+        ``wait=True`` (default) the same guarantee holds here: the
+        call returns once THIS gradient is applied. Applies remain
+        FIFO-serialized by the single writer thread; workers never
+        barrier against each other (hogwild semantics preserved).
+        ``wait=False`` gives fully fire-and-forget pushes.
+        """
+        if self._failed is not None:
+            raise RuntimeError("parameter server failed") from self._failed
+        done = threading.Event() if wait else None
+        self._queue.put((grads, done))
+        if done is not None and not done.wait(timeout):
+            raise TimeoutError("parameter server apply timed out")
+
+    def _apply_loop(self):
+        while self._running:
+            try:
+                grads, done = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                version, params = self.slot.read()
+                grads = jax.device_put(grads, self.device)
+                new_params, new_opt = self._apply_fn(
+                    params, self._opt_state, grads
+                )
+                self._opt_state = new_opt
+                self.slot.swap(new_params)
+                self._applied += 1
+            except Exception as e:  # tolerate a bounded error count
+                self._errors += 1
+                if self._errors > MAX_TOLERATED_ERRORS:
+                    self._failed = e
+                    self._running = False
+            finally:
+                if done is not None:
+                    done.set()
+                self._queue.task_done()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until all queued gradients are fully applied (not just
+        popped — ``unfinished_tasks`` covers the in-flight apply)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while self._queue.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    # ------------------------------------------------------------------
+    # Early stopping
+    # ------------------------------------------------------------------
+
+    def post_loss(self, loss: float) -> bool:
+        """Windowed-average early-stop vote. Returns True => stop.
+
+        Parity: ``POST /losses`` (server.py:102-123): collect one loss
+        per worker, average a full window, feed the patience tracker.
+        """
+        with self._loss_lock:
+            if self._stop_flag:
+                return True
+            if self._stopper is None:
+                return False
+            self._losses.append(float(loss))
+            if len(self._losses) >= self.window_len:
+                avg = float(np.mean(self._losses))
+                self._losses.clear()
+                if self._stopper.step(avg):
+                    self._stop_flag = True
+        return self._stop_flag
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop_flag
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def stop(self):
+        self._running = False
+        if self._writer.is_alive():
+            self._writer.join(timeout=5.0)
+
+    def final_state(self):
+        """(params, model_state) after draining pending applies —
+        what ``hogwild.train`` pulls at the end (hogwild.py:179-182)."""
+        self.drain()
+        _, params = self.slot.read()
+        return params, self._model_state
+
+
+# ---------------------------------------------------------------------------
+# HTTP wire (stdlib; the reference used Flask — server.py:79-149)
+# ---------------------------------------------------------------------------
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+class ParamServerHttp:
+    """Expose a :class:`ParameterServer` over HTTP/1.1.
+
+    Routes mirror the reference wire (hogwild.py:31-62):
+    ``GET /`` liveness, ``GET /parameters`` (dill, honors the
+    ``X-Have-Version`` header with 204 when not newer),
+    ``POST /update`` (dill grads), ``POST /losses`` (dill float ->
+    dill {'stop': bool}).
+    """
+
+    def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
+                 port: int = 3000):
+        self.server = server
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        ps = self.server
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet, like werkzeug->ERROR
+                pass  # (server.py:28-30 parity)
+
+            def _send(self, code: int, body: bytes = b""):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/":
+                    self._send(200, b"sparktorch-tpu parameter server")
+                elif self.path.startswith("/parameters"):
+                    have = int(self.headers.get("X-Have-Version", "-1"))
+                    snap = ps.get_parameters(have)
+                    if snap is None:
+                        self._send(204)
+                    else:
+                        version, params = snap
+                        body = dill.dumps((version, _to_host(params)))
+                        self._send(200, body)
+                else:
+                    self._send(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length)
+                if self.path == "/update":
+                    try:
+                        ps.push_gradients(dill.loads(raw))
+                        self._send(200, b"OK")
+                    except Exception:
+                        self._send(500)
+                elif self.path == "/losses":
+                    stop = ps.post_loss(dill.loads(raw))
+                    self._send(200, dill.dumps({"stop": bool(stop)}))
+                else:
+                    self._send(404)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

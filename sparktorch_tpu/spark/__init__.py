@@ -1,0 +1,7 @@
+"""Optional PySpark integration.
+
+Everything in this subpackage requires ``pyspark`` at import time; the
+core framework never imports it. The baked image for this repo does
+not ship pyspark, so these modules are exercised only in environments
+that provide it (the reference's deployment target).
+"""

@@ -1,0 +1,87 @@
+"""Decode/encode Spark-ML pipelines that carry Python stages.
+
+Reference mechanism (``sparktorch/pipeline_util.py``): PySpark cannot
+persist pure-Python Transformers, so the reference dill-dumps the
+Python object, zlib-compresses it, renders the bytes as a
+comma-joined decimal string and stores it as the stopwords list of a
+``StopWordsRemover`` (the JVM "carrier class"), tagged with a magic
+GUID (:16-31, :112-130); ``unwrap`` walks loaded stages and
+re-hydrates carriers, recursing into nested pipelines (:49-77).
+
+This adapter interoperates with that on-disk format: pipelines saved
+by the reference (or by this adapter) load back into live Python
+objects. The GUID below matches the reference's tag so *existing*
+saved pipelines remain readable — it is a file-format constant, like
+a magic number.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, List
+
+import dill
+
+try:
+    from pyspark.ml import Pipeline as SparkPipeline
+    from pyspark.ml import PipelineModel as SparkPipelineModel
+    from pyspark.ml.feature import StopWordsRemover
+except ImportError as _e:  # pragma: no cover - exercised only w/ pyspark
+    raise ImportError(
+        "sparktorch_tpu.spark requires pyspark; install it or use the "
+        "native sparktorch_tpu.ml.Pipeline persistence instead"
+    ) from _e
+
+# File-format constant: the magic id tagging carrier stages. Matches
+# the reference's on-disk tag (pipeline_util.py:27) so pipelines saved
+# by the reference remain readable.
+CARRIER_GUID = "4c1740b00d3c4ff6806a1402321572cb"
+
+
+def encode_python_stage(obj: Any, uid: str) -> StopWordsRemover:
+    """Pack a Python stage into a JVM-persistable carrier stage."""
+    payload = zlib.compress(dill.dumps(obj))
+    # Trailing comma matters: the reference's reader does
+    # ``split(',')[0:-1]`` (pipeline_util.py:35), so a string without
+    # it would lose its last byte there.
+    as_decimal = "".join(f"{b}," for b in payload)
+    carrier = StopWordsRemover(inputCol=uid, outputCol=uid + "_out")
+    carrier.setStopWords([as_decimal, CARRIER_GUID])
+    return carrier
+
+
+def decode_carrier_stage(stage) -> Any:
+    """Carrier stage -> live Python object."""
+    words: List[str] = stage.getStopWords()
+    payload = bytes(int(tok) for tok in words[0].split(",") if tok)
+    return dill.loads(zlib.decompress(payload))
+
+
+def is_carrier(stage) -> bool:
+    if not isinstance(stage, StopWordsRemover):
+        return False
+    words = stage.getStopWords()
+    return bool(words) and words[-1] == CARRIER_GUID
+
+
+def unwrap_spark_pipeline(pipeline):
+    """Re-hydrate carrier stages in a loaded Spark pipeline.
+
+    Parity: ``PysparkPipelineWrapper.unwrap`` (pipeline_util.py:49-77),
+    including recursion into nested pipelines.
+    """
+    if isinstance(pipeline, (SparkPipeline, SparkPipelineModel)):
+        stages = pipeline.getStages() if hasattr(pipeline, "getStages") else pipeline.stages
+        new_stages = []
+        for stage in stages:
+            if is_carrier(stage):
+                new_stages.append(decode_carrier_stage(stage))
+            elif isinstance(stage, (SparkPipeline, SparkPipelineModel)):
+                new_stages.append(unwrap_spark_pipeline(stage))
+            else:
+                new_stages.append(stage)
+        if hasattr(pipeline, "setStages"):
+            pipeline.setStages(new_stages)
+        else:
+            pipeline.stages = new_stages
+    return pipeline

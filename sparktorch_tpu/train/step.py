@@ -1,0 +1,232 @@
+"""The compiled SPMD train step.
+
+This module replaces the reference's entire per-step hot path
+(``distributed.py:141-204``): zero_grad -> minibatch sample -> forward
+-> loss (with long-label retry) -> backward -> per-parameter
+``dist.all_reduce(SUM)`` + divide -> early-stop all_reduces ->
+``optimizer.step()`` — a Python loop doing one gloo collective *per
+parameter per step*.
+
+TPU-native redesign: ONE jitted function. Inside a ``shard_map`` over
+the mesh's batch axes, each shard samples its own minibatch from its
+resident data shard, computes the local weighted-SUM gradient, and a
+single fused ``psum`` of (grads, loss_num, weight_den) produces the
+globally weighted-mean gradient — mathematically the reference's
+``grad_sum / (world_size - 1)`` (``distributed.py:180-182``) but
+weight-correct under ragged/empty shards and lowered by XLA onto ICI.
+The early-stop signal needs no extra collective: the returned loss is
+already the global mean, replicated on every host
+(vs. ``distributed.py:186-197``'s two extra all_reduces per step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, replicated
+from sparktorch_tpu.utils.data import DataBatch, sample_minibatch
+
+try:  # jax>=0.6 top-level export; fall back for older trees
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+class TrainState(NamedTuple):
+    """Carried training state. ``model_state`` holds non-trainable
+    collections (e.g. batch_stats); replicated across the mesh the way
+    the reference replicates the full model (``distributed.py:115``)."""
+
+    step: jax.Array
+    params: Any
+    model_state: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+class StepMetrics(NamedTuple):
+    loss: jax.Array        # global weighted-mean train loss
+    examples: jax.Array    # real (weight>0) examples this step, global
+    grad_norm: jax.Array
+
+
+def _split_variables(variables) -> Tuple[Any, Any]:
+    variables = dict(variables)
+    params = variables.pop("params", variables)
+    return params, variables
+
+
+def create_train_state(
+    spec,
+    rng: jax.Array,
+    sample_x: Optional[jax.Array] = None,
+    tx: Optional[optax.GradientTransformation] = None,
+) -> TrainState:
+    """Initialize params + optimizer state from a ModelSpec."""
+    tx = tx or spec.make_optimizer()
+    variables = spec.init_params(rng, sample_x)
+    params, model_state = _split_variables(variables)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=tx.init(params),
+        rng=rng,
+    )
+
+
+def _forward(apply_fn, params, model_state, x, train: bool):
+    """Apply with mutable non-trainable collections when present."""
+    variables = {"params": params, **model_state}
+    if model_state and train:
+        mutable = list(model_state.keys())
+        preds, new_state = apply_fn(variables, x, mutable=mutable)
+        return preds, new_state
+    preds = apply_fn(variables, x)
+    return preds, model_state
+
+
+def make_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    mini_batch: Optional[int] = None,
+    axis_names: Tuple[str, ...] = BATCH_AXES,
+) -> Callable[[TrainState, DataBatch], Tuple[TrainState, StepMetrics]]:
+    """Build the jitted SPMD train step over ``mesh``.
+
+    Semantics match one iteration of ``distributed.py:141-204`` with
+    the quirks fixed: weighting is exact under ragged shards, and the
+    "long label retry" is gone because losses promote dtypes at trace
+    time (see utils/losses.py).
+
+    ``mini_batch`` is the GLOBAL minibatch size (the reference's
+    ``miniBatch`` is per-worker on a per-partition loop; here configs
+    port unchanged because world-total examples per step match): each
+    shard samples ``ceil(mini_batch / n_batch_shards)`` rows locally.
+    """
+    n_shards = 1
+    for ax in axis_names:
+        n_shards *= mesh.shape[ax]
+    per_shard_mb = None
+    if mini_batch is not None and mini_batch > 0:
+        per_shard_mb = max(1, -(-mini_batch // n_shards))
+
+    def shard_step(state: TrainState, batch: DataBatch):
+        # Per-shard sampling key: replicated rng folded with the shard
+        # index — data selection differs per shard, carried rng stays
+        # replicated so the output state is provably identical on all
+        # shards.
+        rng, next_rng = jax.random.split(state.rng)
+        shard_id = jnp.zeros((), jnp.int32)
+        for ax in axis_names:
+            shard_id = shard_id * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        sample_key = jax.random.fold_in(rng, shard_id)
+
+        if per_shard_mb is not None and per_shard_mb < batch.x.shape[0]:
+            mb = sample_minibatch(batch, sample_key, per_shard_mb)
+        else:
+            mb = batch
+
+        def weighted_sums(params):
+            preds, new_model_state = _forward(
+                apply_fn, params, state.model_state, mb.x, train=True
+            )
+            per = loss_fn(preds, mb.y)
+            num = jnp.sum(per * mb.w)
+            den = jnp.sum(mb.w)
+            return num, (den, new_model_state)
+
+        (num, (den, new_model_state)), grads_num = jax.value_and_grad(
+            weighted_sums, has_aux=True
+        )(state.params)
+
+        # ONE fused collective for everything the step needs globally.
+        num_g = jax.lax.psum(num, axis_names)
+        den_g = jax.lax.psum(den, axis_names)
+        grads_g = jax.lax.psum(grads_num, axis_names)
+        safe_den = jnp.maximum(den_g, 1.0)
+        grads = jax.tree.map(lambda g: g / safe_den, grads_g)
+        loss = num_g / safe_den
+
+        # Non-trainable collections (batch_stats) sync by global mean.
+        if state.model_state:
+            new_model_state = jax.tree.map(
+                lambda a: jax.lax.pmean(a, axis_names)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                new_model_state,
+            )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt_state,
+            rng=next_rng,
+        )
+        return new_state, StepMetrics(loss=loss, examples=den_g, grad_norm=gnorm)
+
+    data_spec = P(axis_names)
+    batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
+    mapped = _shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), batch_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def make_eval_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    mesh: Mesh,
+    axis_names: Tuple[str, ...] = BATCH_AXES,
+) -> Callable[[TrainState, DataBatch], jax.Array]:
+    """Global weighted-mean validation loss — the per-iteration val
+    forward of ``distributed.py:166-176``, compiled and collective."""
+
+    def shard_eval(state: TrainState, batch: DataBatch):
+        preds, _ = _forward(
+            apply_fn, state.params, state.model_state, batch.x, train=False
+        )
+        per = loss_fn(preds, batch.y)
+        num = jax.lax.psum(jnp.sum(per * batch.w), axis_names)
+        den = jax.lax.psum(jnp.sum(batch.w), axis_names)
+        return num / jnp.maximum(den, 1.0)
+
+    data_spec = P(axis_names)
+    batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
+    mapped = _shard_map(
+        shard_eval,
+        mesh=mesh,
+        in_specs=(P(), batch_specs),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def make_forward_fn(apply_fn: Callable) -> Callable:
+    """Jitted batched inference forward (used by the Transformer side;
+    fixes the reference's batch-1-per-row UDF pathology,
+    ``torch_distributed.py:106``)."""
+
+    @jax.jit
+    def forward(params, model_state, x):
+        variables = {"params": params, **(model_state or {})}
+        return apply_fn(variables, x)
+
+    return forward

@@ -1,0 +1,155 @@
+"""Feature batching, validation split, shard-safe padding.
+
+Reference capability (``sparktorch/util.py``):
+
+- ``DataObj(x_train, y_train, x_val, y_val)`` per-row container
+  (``util.py:34``) built row-wise by ``handle_data``
+  (``torch_distributed.py:43-55``)
+- ``handle_features`` stacks per-row numpy arrays into batch tensors
+  and does a random validation split (``util.py:57-100``)
+
+TPU-native redesign:
+
+- :class:`DataBatch` is a *batched* (x, y, w) triple. ``w`` is a
+  per-example weight used for (a) masking padding rows and (b) the
+  empty-shard protocol: a device shard with no real data carries an
+  all-zero-weight batch, so the globally-weighted loss/grad mean is
+  unaffected while every device still enters the same collectives.
+  This replaces the reference's phantom-rank / ``process_generic_model``
+  zero-gradient mock participant (``distributed.py:46-63,131-133``).
+- Batches are padded to a common static shape per shard: XLA requires
+  static shapes; ragged partitions become weight-masked padding instead
+  of the dynamic per-partition sizes the reference tolerates.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataBatch(NamedTuple):
+    """Batched examples. ``y`` may equal ``x`` (autoencoder, label-free
+    mode — the reference's ``useVectorOut``/no-label path,
+    ``torch_distributed.py:45-55``). ``w`` is float32 (batch,)."""
+
+    x: jax.Array
+    y: jax.Array
+    w: jax.Array
+
+    @property
+    def size(self) -> int:
+        return self.x.shape[0]
+
+    def real_count(self) -> jax.Array:
+        return jnp.sum(self.w)
+
+
+def _stack_rows(
+    rows: Sequence, has_label: bool
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    xs, ys = [], []
+    for row in rows:
+        if has_label:
+            x, y = row
+            ys.append(np.asarray(y))
+        else:
+            x = row
+        xs.append(np.asarray(x, dtype=np.float32))
+    x = np.stack(xs) if xs else np.zeros((0, 1), np.float32)
+    y = np.stack(ys) if ys else None
+    return x, y
+
+
+def handle_features(
+    data: Union[Iterable, np.ndarray],
+    labels: Optional[np.ndarray] = None,
+    validation_pct: float = 0.0,
+    seed: int = 0,
+) -> Tuple[DataBatch, Optional[DataBatch]]:
+    """Stack rows into a train batch (+ optional validation batch).
+
+    Parity: ``handle_features`` (util.py:57-100) — numpy stacking plus
+    a random validation split. Accepts either parallel ``data``/
+    ``labels`` arrays or an iterable of ``(x, y)`` rows / bare ``x``
+    rows (the reference's ``DataObj`` stream).
+    """
+    if labels is None and not isinstance(data, np.ndarray):
+        rows = list(data)
+        if rows and isinstance(rows[0], tuple) and len(rows[0]) == 2:
+            x, y = _stack_rows(rows, has_label=True)
+        else:
+            x, y = _stack_rows(rows, has_label=False)
+    else:
+        x = np.asarray(data, dtype=np.float32)
+        y = np.asarray(labels) if labels is not None else None
+
+    if y is None:
+        y = x  # label-free / autoencoder target (util.py:69-74 analog)
+
+    n = x.shape[0]
+    w = np.ones((n,), np.float32)
+    if validation_pct and validation_pct > 0.0 and n > 1:
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        n_val = max(1, int(n * validation_pct))
+        val_idx, train_idx = perm[:n_val], perm[n_val:]
+        train = DataBatch(
+            jnp.asarray(x[train_idx]), jnp.asarray(y[train_idx]), jnp.asarray(w[train_idx])
+        )
+        val = DataBatch(
+            jnp.asarray(x[val_idx]), jnp.asarray(y[val_idx]), jnp.asarray(w[val_idx])
+        )
+        return train, val
+    return DataBatch(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)), None
+
+
+def pad_batch(batch: DataBatch, to_size: int) -> DataBatch:
+    """Zero-pad to a static size; padding rows get weight 0."""
+    n = batch.size
+    if n == to_size:
+        return batch
+    if n > to_size:
+        raise ValueError(f"batch of {n} cannot be padded down to {to_size}")
+    pad = to_size - n
+
+    def _pad(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    return DataBatch(_pad(batch.x), _pad(batch.y), _pad(batch.w))
+
+
+def empty_batch(x_shape: Sequence[int], y_shape: Sequence[int],
+                batch_size: int, x_dtype=jnp.float32, y_dtype=jnp.float32) -> DataBatch:
+    """An all-padding batch for a shard with no data — the empty-
+    partition safety valve (``distributed.py:131-133`` analog)."""
+    return DataBatch(
+        jnp.zeros((batch_size, *x_shape), x_dtype),
+        jnp.zeros((batch_size, *y_shape), y_dtype),
+        jnp.zeros((batch_size,), jnp.float32),
+    )
+
+
+def pad_to_multiple(batch: DataBatch, multiple: int) -> DataBatch:
+    """Pad so the batch divides evenly across ``multiple`` shards."""
+    n = batch.size
+    target = max(multiple, ((n + multiple - 1) // multiple) * multiple)
+    return pad_batch(batch, target)
+
+
+def sample_minibatch(
+    batch: DataBatch, rng: jax.Array, mini_batch: int
+) -> DataBatch:
+    """Uniform with-replacement minibatch sampling, traceable under jit.
+
+    Parity: the reference samples ``random.sample(range(len), mini_batch)``
+    per step (``distributed.py:146-149``). Sampling happens inside the
+    compiled step (static output shape) so the hot loop stays on-device.
+    """
+    n = batch.size
+    idx = jax.random.randint(rng, (mini_batch,), 0, n)
+    return DataBatch(batch.x[idx], batch.y[idx], batch.w[idx])

@@ -1,0 +1,67 @@
+"""Patience-based early stopping.
+
+Parity: ``sparktorch/early_stopper.py:8-56`` — best-metric tracker with
+min/max mode, abs/pct ("rel") delta, NaN -> immediate stop, and the
+patience-0 degenerate mode that never stops. Used per-driver here: in
+SPMD the jitted step returns a *globally reduced* loss replicated on
+every host, so each host's stopper reaches the identical decision and
+no separate stop-flag all_reduce is needed (the reference needed two
+extra collectives per step for this, ``distributed.py:186-197``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+class EarlyStopping:
+    def __init__(
+        self,
+        mode: str = "min",
+        min_delta: float = 0.0,
+        patience: int = 10,
+        percentage: bool = False,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode {mode!r} is unknown")
+        self.mode = mode
+        self.min_delta = min_delta
+        self.patience = patience
+        self.percentage = percentage
+        self.best: Optional[float] = None
+        self.num_bad_epochs = 0
+
+    def step(self, metric: float) -> bool:
+        """Returns True when training should stop."""
+        metric = float(metric)
+        if self.patience == 0:
+            # Degenerate mode: track nothing, never stop
+            # (early_stopper.py:19-21).
+            return False
+        if self.best is None:
+            self.best = metric
+            return False
+        if math.isnan(metric):
+            return True  # early_stopper.py:28-29
+        if self._is_better(metric):
+            self.num_bad_epochs = 0
+            self.best = metric
+        else:
+            self.num_bad_epochs += 1
+        return self.num_bad_epochs >= self.patience
+
+    def _is_better(self, metric: float) -> bool:
+        # early_stopper.py:42-56
+        if not self.percentage:
+            if self.mode == "min":
+                return metric < self.best - self.min_delta
+            return metric > self.best + self.min_delta
+        delta = abs(self.best) * self.min_delta / 100.0
+        if self.mode == "min":
+            return metric < self.best - delta
+        return metric > self.best + delta
+
+    def reset(self) -> None:
+        self.best = None
+        self.num_bad_epochs = 0

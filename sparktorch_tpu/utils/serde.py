@@ -1,0 +1,321 @@
+"""Model/optimizer/loss packaging — the L1 serde core.
+
+Reference capability being reproduced (``sparktorch/util.py``):
+
+- ``TorchObj`` namedtuple + dill/base64 encode/decode (``util.py:30-54``)
+- ``serialize_torch_obj`` — eager packaging into a JSON envelope
+  ``{'torch_obj': <b64 dill>, 'shapes': [param shapes]}``
+  (``util.py:182-201``)
+- ``serialize_torch_obj_lazy`` — *classes* + ctor kwargs are shipped so
+  the model is first instantiated on the workers and the driver never
+  holds weights (``util.py:148-179``; README.md:115-132)
+- ``load_base_torch`` / ``load_torch_model`` / ``load_optimizer``
+  (``util.py:103-145,204-208``)
+
+TPU-native redesign:
+
+- The payload is a :class:`ModelSpec` describing a Flax module, a pure
+  loss fn and an optax optimizer — all functional, so "lazy" is the
+  *default* posture: parameters are created by ``module.init`` on the
+  worker, directly under the device mesh's sharding.
+- Shape recording uses ``jax.eval_shape`` — abstract tracing, zero
+  FLOPs, zero host memory for weights. This is strictly stronger than
+  the reference's lazy path, which still builds a temp model on the
+  driver to read shapes (``util.py:164-165``).
+- The JSON envelope keeps the reference's two-field contract
+  (payload + shapes) so external tooling that inspects the envelope
+  keeps working; the shapes field is what the reference's phantom
+  rank consumed (``distributed.py:239-246``) and what our parameter
+  server uses to preallocate HBM buffers.
+"""
+
+from __future__ import annotations
+
+import base64
+import codecs
+import dataclasses
+import json
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple, Union
+
+import dill
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sparktorch_tpu.utils.losses import LossFn, resolve_loss
+
+ENVELOPE_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# Optimizer registry: name -> optax ctor. torch.optim spellings are accepted
+# (with their hyperparameter names mapped) so reference users can keep their
+# configs; util.py:204-208 binds a torch optimizer class the same way.
+# ---------------------------------------------------------------------------
+
+_TORCH_PARAM_MAP = {"lr": "learning_rate", "weight_decay": "weight_decay"}
+
+
+def _map_opt_kwargs(kwargs: Mapping[str, Any]) -> dict:
+    out = {}
+    for k, v in kwargs.items():
+        out[_TORCH_PARAM_MAP.get(k, k)] = v
+    return out
+
+
+def _sgd(learning_rate=0.01, momentum=0.0, nesterov=False, **kw):
+    return optax.sgd(learning_rate, momentum=momentum or None, nesterov=nesterov)
+
+
+OPTIMIZER_REGISTRY: dict[str, Callable[..., optax.GradientTransformation]] = {
+    "sgd": _sgd,
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "rmsprop": optax.rmsprop,
+    "adagrad": optax.adagrad,
+    "adafactor": optax.adafactor,
+    "lamb": optax.lamb,
+    "lion": optax.lion,
+    # torch.optim class-name spellings.
+    "SGD": _sgd,
+    "Adam": optax.adam,
+    "AdamW": optax.adamw,
+    "RMSprop": optax.rmsprop,
+    "Adagrad": optax.adagrad,
+}
+
+
+def resolve_optimizer(
+    optimizer: Union[str, Callable, optax.GradientTransformation, None],
+    optimizer_params: Optional[Mapping[str, Any]] = None,
+) -> optax.GradientTransformation:
+    """Bind an optimizer spec to an optax GradientTransformation.
+
+    Parity: ``util.py:204-208`` (``load_optimizer`` binding a torch
+    optimizer class to params). optax transformations are param-free
+    until ``init``, so binding is just construction.
+    """
+    params = _map_opt_kwargs(optimizer_params or {})
+    if optimizer is None:
+        return optax.sgd(params.pop("learning_rate", 0.01))
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    if isinstance(optimizer, str):
+        try:
+            ctor = OPTIMIZER_REGISTRY[optimizer]
+        except KeyError:
+            raise ValueError(
+                f"Unknown optimizer {optimizer!r}; known: {sorted(OPTIMIZER_REGISTRY)}"
+            ) from None
+        return ctor(**params)
+    # A callable ctor (e.g. optax.adam itself, or a user factory).
+    return optimizer(**params)
+
+
+# ---------------------------------------------------------------------------
+# ModelSpec
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """The unit of model shipment — analog of ``TorchObj`` (util.py:30-32).
+
+    Exactly one of ``module`` (eager) or ``module_cls`` (lazy) is set.
+    ``loss`` is a registry name or a pure ``(preds, targets) ->
+    per-example loss`` callable. ``optimizer`` may be a registry name,
+    an optax transformation, or a ctor; name+params is the most
+    portable (it round-trips through dill without closures).
+    """
+
+    module: Any = None
+    module_cls: Optional[type] = None
+    module_kwargs: dict = dataclasses.field(default_factory=dict)
+    loss: Union[str, LossFn] = "mse"
+    optimizer: Union[str, Callable, optax.GradientTransformation, None] = "sgd"
+    optimizer_params: dict = dataclasses.field(default_factory=dict)
+    input_shape: Optional[Tuple[int, ...]] = None  # per-example, no batch dim
+    input_dtype: str = "float32"
+    is_lazy: bool = False
+    param_shapes: Optional[list] = None  # recorded at serialize time
+
+    # -- materialization (worker side) ------------------------------------
+
+    def make_module(self):
+        if self.module is not None:
+            return self.module
+        if self.module_cls is None:
+            raise ValueError("ModelSpec has neither module nor module_cls")
+        return self.module_cls(**self.module_kwargs)
+
+    def loss_fn(self) -> LossFn:
+        return resolve_loss(self.loss)
+
+    def make_optimizer(self) -> optax.GradientTransformation:
+        return resolve_optimizer(self.optimizer, self.optimizer_params)
+
+    def example_input(self, batch_size: int = 1) -> jax.ShapeDtypeStruct:
+        if self.input_shape is None:
+            raise ValueError("ModelSpec.input_shape not set")
+        return jax.ShapeDtypeStruct(
+            (batch_size,) + tuple(self.input_shape), jnp.dtype(self.input_dtype)
+        )
+
+    def init_params(self, rng: jax.Array, sample_x: Optional[jax.Array] = None):
+        """Instantiate parameters on this process's devices.
+
+        Parity: ``load_torch_model`` lazy instantiation
+        (``util.py:125-134``) — but params come out of ``module.init``
+        already placed per the active mesh context.
+        """
+        module = self.make_module()
+        if sample_x is None:
+            spec = self.example_input()
+            sample_x = jnp.zeros(spec.shape, spec.dtype)
+        variables = module.init(rng, sample_x)
+        return variables
+
+    def abstract_params(self, rng: Optional[jax.Array] = None):
+        """Shapes/dtypes of the param pytree with ZERO allocation.
+
+        The driver-side analog of the reference's shape recording
+        (``util.py:164-165,196-199``) — consumed by the parameter
+        server and the shapes field of the envelope.
+        """
+        module = self.make_module()
+        spec = self.example_input()
+        key = rng if rng is not None else jax.random.key(0)
+        return jax.eval_shape(
+            lambda k, x: module.init(k, x),
+            key,
+            spec,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Encode / decode (dill + base64, JSON envelope) — util.py:37-54,182-201
+# ---------------------------------------------------------------------------
+
+
+def spec_encoder(obj: Any) -> str:
+    """dill -> base64 str. Parity: ``torch_encoder`` (util.py:37-43)."""
+    return codecs.encode(dill.dumps(obj), "base64").decode()
+
+
+def spec_decoder(s: str) -> Any:
+    """base64 str -> object. Parity: ``torch_decoder`` (util.py:46-54)."""
+    if isinstance(s, str):
+        s = s.encode()
+    return dill.loads(codecs.decode(s, "base64"))
+
+
+def _shapes_of(spec: ModelSpec) -> Optional[list]:
+    if spec.input_shape is None:
+        return None
+    abstract = spec.abstract_params()
+    return [list(leaf.shape) for leaf in jax.tree.leaves(abstract)]
+
+
+def _envelope(spec: ModelSpec) -> str:
+    spec.param_shapes = _shapes_of(spec)
+    return json.dumps(
+        {
+            "torch_obj": spec_encoder(spec),  # field name kept for envelope parity
+            "shapes": spec.param_shapes,
+            "version": ENVELOPE_VERSION,
+            "framework": "sparktorch_tpu",
+        }
+    )
+
+
+def serialize_model(
+    model: Any,
+    criterion: Union[str, LossFn] = "mse",
+    optimizer: Union[str, Callable, optax.GradientTransformation, None] = "sgd",
+    optimizer_params: Optional[Mapping[str, Any]] = None,
+    input_shape: Optional[Sequence[int]] = None,
+    input_dtype: str = "float32",
+) -> str:
+    """Eagerly package a Flax module + loss + optimizer.
+
+    Parity: ``serialize_torch_obj`` (util.py:182-201). The module
+    *object* is shipped (its hyperparameters; Flax modules carry no
+    weights), the loss is a name or pure fn, the optimizer a name/ctor
+    with params.
+    """
+    spec = ModelSpec(
+        module=model,
+        loss=criterion,
+        optimizer=optimizer,
+        optimizer_params=dict(optimizer_params or {}),
+        input_shape=tuple(input_shape) if input_shape is not None else None,
+        input_dtype=input_dtype,
+        is_lazy=False,
+    )
+    return _envelope(spec)
+
+
+def serialize_model_lazy(
+    model: type,
+    criterion: Union[str, LossFn] = "mse",
+    optimizer: Union[str, Callable, None] = "sgd",
+    optimizer_params: Optional[Mapping[str, Any]] = None,
+    model_parameters: Optional[Mapping[str, Any]] = None,
+    input_shape: Optional[Sequence[int]] = None,
+    input_dtype: str = "float32",
+) -> str:
+    """Package a module *class* + ctor kwargs; instantiation happens on
+    workers so the driver never holds weights.
+
+    Parity: ``serialize_torch_obj_lazy`` (util.py:148-179). Shapes are
+    recorded abstractly via ``jax.eval_shape`` rather than by building
+    a temporary model (the reference's ``util.py:164-165``).
+    """
+    spec = ModelSpec(
+        module_cls=model,
+        module_kwargs=dict(model_parameters or {}),
+        loss=criterion,
+        optimizer=optimizer,
+        optimizer_params=dict(optimizer_params or {}),
+        input_shape=tuple(input_shape) if input_shape is not None else None,
+        input_dtype=input_dtype,
+        is_lazy=True,
+    )
+    return _envelope(spec)
+
+
+def deserialize_model(payload: Union[str, ModelSpec]) -> ModelSpec:
+    """Envelope/b64 string -> ModelSpec.
+
+    Parity: ``load_base_torch`` + ``load_torch_model``
+    (util.py:103-145). Accepts the JSON envelope, a bare base64 dill
+    string, or an already-decoded ModelSpec (idempotent).
+    """
+    if isinstance(payload, ModelSpec):
+        return payload
+    text = payload.strip()
+    if text.startswith("{"):
+        env = json.loads(text)
+        spec = spec_decoder(env["torch_obj"])
+        spec.param_shapes = env.get("shapes")
+        return spec
+    return spec_decoder(text)
+
+
+def envelope_shapes(payload: str) -> Optional[list]:
+    """Read param shapes from the envelope WITHOUT unpickling.
+
+    The reference's phantom rank consumed exactly this
+    (``load_base_torch`` -> shapes, util.py:103-110;
+    ``distributed.py:239-246``); our parameter server uses it to
+    preallocate buffers before any worker connects.
+    """
+    text = payload.strip()
+    if not text.startswith("{"):
+        return None
+    return json.loads(text).get("shapes")
+
+
+# Reference-compatible export names (sparktorch/__init__.py:1-4).
+serialize_torch_obj = serialize_model
+serialize_torch_obj_lazy = serialize_model_lazy

@@ -1,0 +1,71 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparktorch_tpu.utils.data import (
+    DataBatch,
+    empty_batch,
+    handle_features,
+    pad_batch,
+    pad_to_multiple,
+)
+
+
+def test_handle_features_arrays():
+    x = np.random.randn(20, 5).astype(np.float32)
+    y = np.arange(20.0)
+    train, val = handle_features(x, y)
+    assert val is None
+    assert train.x.shape == (20, 5)
+    assert train.y.shape == (20,)
+    assert float(train.real_count()) == 20
+
+
+def test_handle_features_rows():
+    rows = [(np.ones(3) * i, float(i)) for i in range(6)]
+    train, _ = handle_features(rows)
+    assert train.x.shape == (6, 3)
+    np.testing.assert_allclose(np.asarray(train.y), np.arange(6.0))
+
+
+def test_handle_features_label_free_targets_inputs():
+    # Autoencoder path: no labels -> y = x (util.py:69-74 analog).
+    x = np.random.randn(8, 4).astype(np.float32)
+    train, _ = handle_features(x)
+    np.testing.assert_allclose(np.asarray(train.x), np.asarray(train.y))
+
+
+def test_validation_split_partition():
+    x = np.random.randn(100, 4).astype(np.float32)
+    y = np.zeros(100, np.float32)
+    train, val = handle_features(x, y, validation_pct=0.2, seed=1)
+    assert val is not None
+    assert val.x.shape[0] == 20
+    assert train.x.shape[0] == 80
+
+
+def test_pad_batch_weights_zero():
+    train, _ = handle_features(np.ones((3, 2), np.float32), np.ones(3, np.float32))
+    padded = pad_batch(train, 8)
+    assert padded.size == 8
+    assert float(padded.real_count()) == 3
+    np.testing.assert_allclose(np.asarray(padded.w), [1, 1, 1, 0, 0, 0, 0, 0])
+
+
+def test_pad_to_multiple():
+    train, _ = handle_features(np.ones((10, 2), np.float32), np.ones(10, np.float32))
+    padded = pad_to_multiple(train, 8)
+    assert padded.size == 16
+    assert float(padded.real_count()) == 10
+
+
+def test_empty_batch_is_all_padding():
+    b = empty_batch((5,), (), batch_size=4)
+    assert b.x.shape == (4, 5)
+    assert float(b.real_count()) == 0.0
+
+
+def test_pad_down_raises():
+    train, _ = handle_features(np.ones((5, 2), np.float32), np.ones(5, np.float32))
+    with pytest.raises(ValueError):
+        pad_batch(train, 3)

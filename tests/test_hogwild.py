@@ -1,0 +1,141 @@
+"""Async (hogwild) mode tests — the reference ships this mode with
+ZERO test coverage (SURVEY §4: "hogwild mode is never tested"). Here
+both the in-process and the HTTP wire paths are exercised for real.
+"""
+
+import numpy as np
+import pytest
+
+from sparktorch_tpu import SparkTorch, serialize_torch_obj
+from sparktorch_tpu.models import ClassificationNet, Net
+from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
+from sparktorch_tpu.train.hogwild import HttpTransport, train_async
+from sparktorch_tpu.utils.serde import deserialize_model
+
+
+def _blob_data(n=400, dim=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(0.0, 1.0, (n // 2, dim)).astype(np.float32)
+    x1 = rng.normal(2.0, 1.0, (n // 2, dim)).astype(np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)]).astype(np.float32)
+    perm = rng.permutation(n)
+    return x[perm], y[perm]
+
+
+@pytest.fixture
+def payload():
+    return serialize_torch_obj(
+        Net(), criterion="mse", optimizer="adam",
+        optimizer_params={"lr": 5e-3}, input_shape=(10,),
+    )
+
+
+def test_param_server_versioned_pull(payload):
+    server = ParameterServer(payload, window_len=2)
+    try:
+        snap = server.get_parameters(-1)
+        assert snap is not None
+        v0, params = snap
+        # Up-to-date client gets None instead of a redundant transfer
+        # (the reference re-ships the full state_dict every iteration,
+        # hogwild.py:103).
+        assert server.get_parameters(v0) is None
+        # A pushed gradient bumps the version.
+        import jax
+
+        grads = jax.tree.map(lambda a: np.ones_like(np.asarray(a)), params)
+        server.push_gradients(grads)
+        server.drain()
+        snap2 = server.get_parameters(v0)
+        assert snap2 is not None and snap2[0] > v0
+        assert server.applied_updates == 1
+    finally:
+        server.stop()
+
+
+def test_param_server_error_tolerance(payload):
+    # server.py:139-142: tolerate up to 10 bad updates, then fail.
+    server = ParameterServer(payload, window_len=2)
+    try:
+        for _ in range(11):
+            server.push_gradients({"not": "a valid grad pytree"})
+        server.drain()
+        with pytest.raises(RuntimeError):
+            server.push_gradients({"still": "bad"})
+    finally:
+        server.stop()
+
+
+def test_hogwild_local_loss_decreases(payload):
+    x, y = _blob_data()
+    result = train_async(payload, x, labels=y, iters=25, partitions=4,
+                         mini_batch=32, seed=0)
+    # Per-minibatch worker losses are noisy under async staleness, so
+    # measure what matters: full-data loss at initial vs final params.
+    import jax.numpy as jnp
+
+    spec = deserialize_model(payload)
+    module = spec.make_module()
+    init_vars = spec.init_params(__import__("jax").random.key(0))
+
+    def full_loss(variables):
+        preds = module.apply(variables, jnp.asarray(x))
+        return float(jnp.mean((preds[:, 0] - jnp.asarray(y)) ** 2))
+
+    before = full_loss(init_vars)
+    after = full_loss({"params": result.params})
+    assert after < before * 0.8, (before, after)
+
+
+def test_hogwild_http_wire(payload):
+    # Full HTTP path: pull / push / losses / liveness over a real
+    # socket (the reference's Flask equivalent, server.py:89-147).
+    x, y = _blob_data(n=128)
+    result = train_async(payload, x, labels=y, iters=6, partitions=2,
+                         transport="http", port=0, seed=0)
+    assert len(result.metrics) == 12
+    versions = [m["version"] for m in result.metrics]
+    assert max(versions) > 0  # weights actually moved over the wire
+
+
+def test_hogwild_early_stop_window(payload):
+    server = ParameterServer(payload, window_len=2, early_stop_patience=1)
+    try:
+        # Feed a worsening loss sequence; window avg grows -> stop.
+        stops = [server.post_loss(v) for v in [1.0, 1.0, 5.0, 5.0, 9.0, 9.0]]
+        assert stops[-1] is True
+        assert server.should_stop
+    finally:
+        server.stop()
+
+
+def test_estimator_hogwild_mode(data):
+    # Through the public Estimator surface (mode='hogwild'), which the
+    # reference never covers in tests.
+    payload = serialize_torch_obj(
+        ClassificationNet(n_classes=2), criterion="nll", optimizer="adam",
+        optimizer_params={"lr": 1e-2}, input_shape=(10,),
+    )
+    est = SparkTorch(
+        inputCol="features", labelCol="label", predictionCol="predictions",
+        torchObj=payload, iters=40, mode="hogwild", partitions=4, miniBatch=64,
+    )
+    model = est.fit(data)
+    res = model.transform(data)
+    rows = res.collect()
+    acc = np.mean([float(r["predictions"]) == float(r["label"]) for r in rows])
+    assert acc > 0.85, acc
+
+
+def test_http_transport_liveness_and_stop(payload):
+    server = ParameterServer(payload, window_len=1, early_stop_patience=1)
+    http = ParamServerHttp(server, port=0).start()
+    try:
+        t = HttpTransport(http.url)
+        assert t.alive()
+        assert t.post_loss(1.0) is False
+        assert t.post_loss(10.0) is True  # worse window -> stop
+    finally:
+        http.stop()
+        server.stop()

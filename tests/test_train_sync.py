@@ -1,0 +1,108 @@
+"""Synchronous SPMD trainer tests.
+
+Strengthened vs the reference (SURVEY §4): the reference only smoke-
+checks that a prediction column appears. Here we assert loss actually
+decreases, empty/ragged shards are harmless, and an 8-device run is
+step-for-step consistent with expectations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparktorch_tpu.models import ClassificationNet, MnistMLP, Net
+from sparktorch_tpu.parallel.mesh import local_mesh
+from sparktorch_tpu.train.step import create_train_state, make_train_step
+from sparktorch_tpu.train.sync import prepare_sharded_batch, train_distributed
+from sparktorch_tpu.utils.data import handle_features
+from sparktorch_tpu.utils.serde import ModelSpec, serialize_model
+
+
+def _blob_data(n=400, dim=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(0.0, 1.0, (n // 2, dim)).astype(np.float32)
+    x1 = rng.normal(2.0, 1.0, (n // 2, dim)).astype(np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)]).astype(np.float32)
+    perm = rng.permutation(n)
+    return x[perm], y[perm]
+
+
+def test_loss_decreases_8dev():
+    x, y = _blob_data()
+    payload = serialize_model(
+        Net(), "mse", "adam", {"lr": 1e-2}, input_shape=(10,)
+    )
+    result = train_distributed(payload, x, labels=y, iters=30, seed=0)
+    losses = [m["loss"] for m in result.metrics]
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert result.metrics[0]["examples"] == 400.0
+
+
+def test_ragged_padding_does_not_skew_loss():
+    # 401 rows over 8 shards -> padding rows with weight 0; the global
+    # weighted mean must count exactly 401 examples (the analog of the
+    # reference's empty-partition protocol, distributed.py:131-133).
+    x, y = _blob_data(n=402)
+    x, y = x[:401], y[:401]
+    payload = serialize_model(Net(), "mse", "sgd", {"lr": 1e-3}, input_shape=(10,))
+    result = train_distributed(payload, x, labels=y, iters=2)
+    assert result.metrics[0]["examples"] == 401.0
+
+
+def test_minibatch_mode():
+    x, y = _blob_data()
+    payload = serialize_model(Net(), "mse", "adam", {"lr": 1e-2}, input_shape=(10,))
+    result = train_distributed(payload, x, labels=y, iters=20, mini_batch=16)
+    losses = [m["loss"] for m in result.metrics]
+    assert losses[-1] < losses[0]
+
+
+def test_validation_split_and_early_stop():
+    x, y = _blob_data()
+    payload = serialize_model(Net(), "mse", "adam", {"lr": 5e-2}, input_shape=(10,))
+    result = train_distributed(
+        payload, x, labels=y, iters=200, validation_pct=0.2,
+        early_stop_patience=3,
+    )
+    assert all(m["val_loss"] is not None for m in result.metrics)
+    # Early stop must have fired well before 200 iters on this problem.
+    assert len(result.metrics) < 200
+
+
+def test_classification_cross_entropy_long_labels():
+    # Integer class labels through cross entropy — the reference needed
+    # a runtime retry for this (distributed.py:153-158).
+    x, y = _blob_data()
+    payload = serialize_model(
+        ClassificationNet(n_classes=2), "nll", "adam", {"lr": 1e-2},
+        input_shape=(10,),
+    )
+    result = train_distributed(payload, x, labels=y.astype(np.int64), iters=30)
+    losses = [m["loss"] for m in result.metrics]
+    assert losses[-1] < losses[0]
+
+
+def test_partition_shuffles():
+    x, y = _blob_data()
+    payload = serialize_model(Net(), "mse", "adam", {"lr": 1e-2}, input_shape=(10,))
+    result = train_distributed(payload, x, labels=y, iters=5, partition_shuffles=3)
+    assert len(result.metrics) == 15
+    assert {m["round"] for m in result.metrics} == {0, 1, 2}
+
+
+def test_single_vs_multi_device_parity():
+    """Full-batch sync training on 1 device and on 8 devices must agree
+    step-for-step (same global weighted-mean gradient) — the assertion
+    SURVEY §4 says the reference never makes."""
+    x, y = _blob_data(n=64)
+    payload = serialize_model(Net(), "mse", "sgd", {"lr": 1e-2}, input_shape=(10,))
+    r1 = train_distributed(payload, x, labels=y, iters=5,
+                           mesh=local_mesh(1), seed=7)
+    r8 = train_distributed(payload, x, labels=y, iters=5,
+                           mesh=local_mesh(8), seed=7)
+    l1 = [m["loss"] for m in r1.metrics]
+    l8 = [m["loss"] for m in r8.metrics]
+    np.testing.assert_allclose(l1, l8, rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(r1.params), jax.tree.leaves(r8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
