@@ -1,0 +1,45 @@
+"""Reference-architecture proxy measurement.
+
+The reference (sparktorch) trains torch models on Spark executors —
+CPU in its own tests/CI (environment.yml pins CPU pytorch; examples
+run local[*]). This measures the same MNIST-CNN workload (batch 1024,
+forward+backward+step) in torch on this machine's CPU to anchor
+bench.py's vs_baseline ratio.
+"""
+import json, time
+import torch
+import torch.nn as nn
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2d(1, 32, 3, padding=1)
+        self.c2 = nn.Conv2d(32, 64, 3, padding=1)
+        self.f1 = nn.Linear(64*7*7, 128)
+        self.f2 = nn.Linear(128, 10)
+    def forward(self, x):
+        x = x.view(-1, 1, 28, 28)
+        x = torch.relu(self.c1(x)); x = torch.max_pool2d(x, 2)
+        x = torch.relu(self.c2(x)); x = torch.max_pool2d(x, 2)
+        x = x.flatten(1)
+        x = torch.relu(self.f1(x))
+        return self.f2(x)
+
+def main():
+    torch.manual_seed(0)
+    model = CNN()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    crit = nn.CrossEntropyLoss()
+    x = torch.randn(1024, 784)
+    y = torch.randint(0, 10, (1024,))
+    for _ in range(3):  # warmup
+        opt.zero_grad(); loss = crit(model(x), y); loss.backward(); opt.step()
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        opt.zero_grad(); loss = crit(model(x), y); loss.backward(); opt.step()
+    dt = time.perf_counter() - t0
+    print(json.dumps({"reference_proxy_examples_per_sec": round(1024*iters/dt, 1)}))
+
+if __name__ == "__main__":
+    main()
