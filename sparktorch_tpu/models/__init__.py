@@ -7,6 +7,20 @@ from sparktorch_tpu.models.simple import (
     MnistMLP,
     MnistCNN,
 )
+from sparktorch_tpu.models.resnet import (
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+)
+from sparktorch_tpu.models.transformer import (
+    TransformerConfig,
+    Transformer,
+    SequenceClassifier,
+    CausalLM,
+    bert_base,
+    tiny_transformer,
+)
 
 __all__ = [
     "MLP",
@@ -16,4 +30,14 @@ __all__ = [
     "NetworkWithParameters",
     "MnistMLP",
     "MnistCNN",
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "TransformerConfig",
+    "Transformer",
+    "SequenceClassifier",
+    "CausalLM",
+    "bert_base",
+    "tiny_transformer",
 ]

@@ -1,0 +1,135 @@
+"""ResNet family (BASELINE configs 3 & 5: ResNet-18 CIFAR-10 hogwild
+training, ResNet-50 batch inference over Parquet).
+
+TPU-native choices: NHWC layout (XLA:TPU's native conv layout),
+bfloat16 compute with float32 params and batch stats, strided-conv
+downsampling, and a stem that accepts flat feature rows (the
+estimator's column matrix) by reshaping to (H, W, C) from a declared
+``input_hw``. BatchNorm runs in ``batch_stats`` mutable collection —
+the SPMD train step syncs the stats by cross-shard mean
+(train/step.py), which the reference's per-executor BN silently never
+does (each gloo worker kept its own running stats,
+``distributed.py:112-115``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 10
+    width: int = 64
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    input_hw: Optional[Tuple[int, int, int]] = None  # (H, W, C) for flat rows
+    small_images: bool = True  # CIFAR-style stem (3x3, no maxpool)
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            if self.input_hw is None:
+                raise ValueError("flat input needs input_hw=(H, W, C)")
+            h, w, c = self.input_hw
+            x = x.reshape(x.shape[0], h, w, c)
+        x = x.astype(self.compute_dtype)
+
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       padding="SAME")
+        # Train/eval switches on collection mutability, not a flag:
+        # apply(..., mutable=['batch_stats']) => batch stats update
+        # (training); plain apply => running averages (inference).
+        # This keeps the generic train step and the compiled inference
+        # path (train/step.py) model-agnostic.
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not self.is_mutable_collection("batch_stats"),
+            momentum=0.9, epsilon=1e-5, dtype=self.compute_dtype,
+        )
+
+        if self.small_images:
+            x = conv(self.width, (3, 3), name="conv_stem")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), name="conv_stem")(x)
+        x = norm(name="norm_stem")(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.width * 2**i, conv=conv, norm=norm, strides=strides,
+                    name=f"stage{i}_block{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def resnet18(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=ResNetBlock,
+                  num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=ResNetBlock,
+                  num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    kw.setdefault("small_images", False)
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                  num_classes=num_classes, **kw)

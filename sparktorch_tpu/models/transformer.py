@@ -1,0 +1,185 @@
+"""Transformer encoder / LM family (BERT-class workloads).
+
+Covers the BASELINE stress configs the reference can only feed through
+its generic DP loop (BERT-base SST-2 fine-tune, BASELINE.md config 4;
+the reference itself contains no transformer or attention code —
+SURVEY §5 "Long-context": *entirely absent*). Long context is
+first-class here:
+
+- ``attn_impl='dense'``: fused-by-XLA softmax attention.
+- ``attn_impl='ring'``: sequence-parallel ring attention
+  (:mod:`sparktorch_tpu.ops.attention`) — the sequence axis is
+  sharded over the mesh's ``sp`` axis and K/V blocks rotate over ICI,
+  so max sequence length scales linearly with the number of chips.
+  Requires running under ``jax.set_mesh(mesh)`` (the sharded trainer
+  does this), because the shard_map island resolves the ambient mesh.
+
+Tensor parallelism: head and FFN dims are sharded over ``tp`` by the
+sharding rules in :mod:`sparktorch_tpu.parallel.sharding_rules`; XLA
+GSPMD inserts the tp collectives. Heads must divide the tp size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from sparktorch_tpu.ops.attention import dense_attention, ring_attention
+from sparktorch_tpu.parallel.mesh import BATCH_AXES
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    n_classes: int = 2
+    dtype: str = "bfloat16"
+    attn_impl: str = "dense"  # 'dense' | 'ring'
+    causal: bool = False
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        dt = cfg.compute_dtype
+        qkv = nn.DenseGeneral(
+            (3, cfg.n_heads, cfg.head_dim), axis=-1, dtype=dt, name="qkv"
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b,s,h,hd)
+
+        if cfg.attn_impl == "ring":
+            spec = P(BATCH_AXES, "sp", "tp", None)
+            attn = shard_map(
+                lambda q, k, v: ring_attention(
+                    q, k, v, axis_name="sp", causal=cfg.causal
+                ),
+                mesh=None,  # ambient mesh (jax.set_mesh)
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            out = attn(q, k, v)
+        else:
+            out = dense_attention(q, k, v, causal=cfg.causal)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=dt, name="proj"
+        )(out)
+
+
+class EncoderLayer(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dt = cfg.compute_dtype
+        h = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=dt, name="ln_mlp")(x)
+        h = nn.Dense(cfg.d_ff, dtype=dt, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=dt, name="mlp_out")(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """Token-id encoder backbone. Accepts int ids or float columns
+    (the estimator's feature matrix is float32; ids are cast)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.config
+        if jnp.issubdtype(ids.dtype, jnp.floating):
+            ids = ids.astype(jnp.int32)
+        b, s = ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype,
+                       name="tok_embed")(ids)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.d_model),
+        )
+        x = tok + pos[None, :s].astype(cfg.compute_dtype)
+        layer = EncoderLayer
+        if cfg.remat:
+            layer = nn.remat(EncoderLayer)
+        for i in range(cfg.n_layers):
+            x = layer(cfg, name=f"layer_{i}")(x)
+        return nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_final")(x)
+
+
+class SequenceClassifier(nn.Module):
+    """BERT-style classifier (SST-2 workload, BASELINE config 4)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        x = Transformer(self.config, name="backbone")(ids)
+        # Mean-pool (padding-id masking is the caller's concern; the
+        # estimator's weighted loss handles padded *examples*).
+        pooled = jnp.mean(x, axis=1)
+        pooled = jnp.tanh(
+            nn.Dense(self.config.d_model, dtype=self.config.compute_dtype,
+                     name="pooler")(pooled)
+        )
+        return nn.Dense(self.config.n_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)
+
+
+class CausalLM(nn.Module):
+    """Decoder-style LM head over the same backbone (long-context
+    training workload for ring attention)."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        cfg = dataclasses.replace(self.config, causal=True)
+        self.backbone = Transformer(cfg)
+        self.lm_head = nn.Dense(cfg.vocab_size, dtype=jnp.float32)
+
+    def __call__(self, ids):
+        x = self.backbone(ids)
+        return self.lm_head(x)
+
+
+def bert_base(n_classes: int = 2, **overrides) -> SequenceClassifier:
+    cfg = TransformerConfig(n_classes=n_classes, **overrides)
+    return SequenceClassifier(cfg)
+
+
+def tiny_transformer(**overrides) -> TransformerConfig:
+    """Small config for tests/dryruns."""
+    defaults = dict(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
+                    d_ff=128, max_len=128)
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)

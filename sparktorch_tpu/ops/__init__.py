@@ -1,0 +1,3 @@
+from sparktorch_tpu.ops.attention import dense_attention, ring_attention
+
+__all__ = ["dense_attention", "ring_attention"]

@@ -1,0 +1,136 @@
+"""Attention ops: dense reference and ring (sequence-parallel).
+
+The reference framework has NO attention/sequence code at all
+(SURVEY §2.4/§5: "no attention, no sequence dimension, no
+ring/blockwise/Ulysses anything") — long-context support is a
+first-class addition of this framework, built the TPU way:
+
+- :func:`dense_attention` — plain softmax attention; XLA fuses it
+  well for moderate sequence lengths.
+- :func:`ring_attention` — blockwise attention for sequences sharded
+  over the ``sp`` mesh axis. Each device holds a sequence block of
+  Q/K/V in HBM; K/V blocks rotate around the ring via ``ppermute``
+  (ICI neighbor hops) while each device accumulates its queries'
+  output with a running log-sum-exp — so the full sequence is never
+  materialized on any one chip and peak memory is O(seq/sp_size).
+  Communication overlaps compute: block s+1's K/V is in flight while
+  block s is being processed (XLA schedules the ppermute async).
+
+Numerics: accumulation in float32 regardless of input dtype;
+streaming-softmax max/denominator carried per query.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Plain attention. Shapes: (batch, seq, heads, head_dim).
+
+    ``q_offset``/``kv_offset`` give the global positions of the local
+    blocks (used for causal masking under sequence sharding).
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    # A fully-masked row (all -inf) softmaxes to NaN; zero it instead.
+    weights = jnp.where(jnp.isnan(weights), 0.0, weights)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+
+
+def _block_contrib(q, k, v, scale, causal, q_pos, k_pos):
+    """One K/V block's (unnormalized out, row max, row denom)."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)  # (b,h,q)
+    # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
+    m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isinf(logits), 0.0, p) if causal else p
+    l = jnp.sum(p, axis=-1)  # (b,h,q)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o, m_safe, l, jnp.isinf(m)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """Sequence-parallel blockwise attention. MUST run inside a
+    ``shard_map`` (or other context) where ``axis_name`` is bound and
+    q/k/v hold this device's sequence block: (batch, seq_local,
+    heads, head_dim).
+
+    The ring: at step s, this device (index i) processes the K/V
+    block originally owned by device ``(i - s) mod n`` and forwards
+    its current block to ``(i + 1) mod n``.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    seq_local = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    q_pos = idx * seq_local + jnp.arange(seq_local)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, s):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src = (idx - s) % n  # owner of the block we hold at step s
+        k_pos = src * seq_local + jnp.arange(seq_local)
+        o_b, m_b, l_b, fully_masked = _block_contrib(
+            q, k_cur, v_cur, scale, causal, q_pos, k_pos
+        )
+        # Streaming log-sum-exp merge.
+        m_new = jnp.maximum(m_acc, m_b)
+        # Fully-masked blocks contribute nothing; keep old max.
+        m_new = jnp.where(fully_masked, m_acc, m_new)
+        # alpha rescales the old accumulator. m_acc == -inf means the
+        # accumulator is still empty: exp(-inf - m_new) must be 0 even
+        # when m_new is also -inf (exp(-inf+inf) would be NaN).
+        alpha = jnp.where(
+            jnp.isneginf(m_acc), 0.0, jnp.exp(m_acc - jnp.where(jnp.isneginf(m_new), 0.0, m_new))
+        )
+        beta = jnp.where(fully_masked, 0.0, jnp.exp(m_b - m_new))
+        l_new = l_acc * alpha + l_b * beta
+        o_new = (
+            o_acc * alpha[..., None].transpose(0, 2, 1, 3)
+            + o_b * beta[..., None].transpose(0, 2, 1, 3)
+        )
+        # Rotate K/V to the next device (skip the final, unused hop).
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    b, _, h, d = q.shape
+    o0 = jnp.zeros((b, seq_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, seq_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, seq_local), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        body, (o0, m0, l0, k, v), jnp.arange(n), length=n
+    )
+    l = jnp.maximum(l, 1e-20)
+    out = o / l[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

@@ -1,0 +1,86 @@
+"""Parameter sharding rules: param path -> PartitionSpec.
+
+The reference replicates the full model on every executor
+(``distributed.py:112-115``) — its only layout. Here layouts are
+first-class: rules map parameter tree paths to mesh axes, XLA GSPMD
+inserts the collectives. Megatron-style conventions for transformers:
+
+- qkv / mlp-in kernels: column-parallel over ``tp`` (output dim)
+- attention-out / mlp-out kernels: row-parallel over ``tp`` (input
+  dim; GSPMD adds the all-reduce after the matmul)
+- embeddings: vocab dim over ``tp``
+- everything else: optionally ``fsdp``-sharded on the largest
+  divisible dim, else replicated
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparktorch_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP, fsdp_param_sharding
+
+
+# (path regex, spec builder taking leaf ndim) — first match wins.
+_TRANSFORMER_RULES = [
+    # qkv DenseGeneral kernel (d_model, 3, heads, head_dim): heads on tp.
+    (re.compile(r".*attn/qkv/kernel$"), lambda nd: P(*([None] * (nd - 2) + [AXIS_TP, None]))),
+    (re.compile(r".*attn/qkv/bias$"), lambda nd: P(*([None] * (nd - 2) + [AXIS_TP, None])) if nd >= 2 else P()),
+    # attention out DenseGeneral kernel (heads, head_dim, d_model): row-parallel.
+    (re.compile(r".*attn/proj/kernel$"), lambda nd: P(*([AXIS_TP] + [None] * (nd - 1)))),
+    # MLP column then row parallel.
+    (re.compile(r".*mlp_in/kernel$"), lambda nd: P(*([None] * (nd - 1) + [AXIS_TP]))),
+    (re.compile(r".*mlp_in/bias$"), lambda nd: P(AXIS_TP) if nd == 1 else P()),
+    (re.compile(r".*mlp_out/kernel$"), lambda nd: P(*([AXIS_TP] + [None] * (nd - 1)))),
+    # Embeddings: vocab over tp, model dim over fsdp.
+    (re.compile(r".*tok_embed/embedding$"), lambda nd: P(AXIS_TP, AXIS_FSDP)),
+    (re.compile(r".*lm_head/kernel$"), lambda nd: P(None, AXIS_TP)),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for key in path:
+        name = getattr(key, "key", None) or getattr(key, "name", None) or str(key)
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def transformer_rules(mesh: Mesh) -> Callable:
+    """Rules callable: (path, leaf) -> NamedSharding."""
+
+    def rule(path, leaf) -> NamedSharding:
+        path_s = _path_str(path)
+        nd = getattr(leaf, "ndim", 0)
+        shape = getattr(leaf, "shape", ())
+        for pattern, builder in _TRANSFORMER_RULES:
+            if pattern.match(path_s):
+                spec = builder(nd)
+                if _spec_fits(spec, shape, mesh):
+                    return NamedSharding(mesh, spec)
+                break
+        return fsdp_param_sharding(mesh, leaf)
+
+    return rule
+
+
+def _spec_fits(spec: P, shape, mesh: Mesh) -> bool:
+    for dim, axis in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axis is None:
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        total = 1
+        for a in axes:
+            total *= mesh.shape[a]
+        if total > 1 and dim % total != 0:
+            return False
+    return True
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[Callable] = None):
+    """Pytree of NamedShardings for a (possibly abstract) param tree."""
+    rules = rules or transformer_rules(mesh)
+    return jax.tree_util.tree_map_with_path(rules, params)

@@ -1,0 +1,194 @@
+"""GSPMD sharded trainer: dp x fsdp x tp x sp in one jitted step.
+
+The shard_map trainer (:mod:`sparktorch_tpu.train.step`) mirrors the
+reference's replicated-model data parallelism. This module is the
+scaling path the reference has no analog for (SURVEY §2.4: TP/SP
+"absent"): parameters are laid out by sharding rules, the batch is
+sharded over dp(+fsdp) and — for sequence models — the sequence axis
+over sp; the loss is a global weighted mean, and XLA GSPMD inserts
+every collective (tp all-reduces, fsdp all-gathers, dp grad
+reduction) over ICI. Ring attention's shard_map island composes
+inside this jit (transformer.py).
+
+Run under ``jax.set_mesh(mesh)`` — :func:`make_sharded_train_step`
+returns a step already wrapped to do so.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparktorch_tpu.parallel.mesh import AXIS_SP, BATCH_AXES, replicated
+from sparktorch_tpu.parallel.sharding_rules import shard_params, transformer_rules
+from sparktorch_tpu.train.step import StepMetrics, TrainState, _split_variables
+from sparktorch_tpu.utils.data import DataBatch
+
+
+def batch_specs(seq_sharded: bool) -> DataBatch:
+    """PartitionSpecs for (x, y, w). Sequence models shard x/y's
+    second dim over sp; targets of LMs are token-level, so y follows
+    x's layout when it has a sequence dim."""
+    if seq_sharded:
+        return DataBatch(
+            x=P(BATCH_AXES, AXIS_SP),
+            y=P(BATCH_AXES, AXIS_SP),
+            w=P(BATCH_AXES),
+        )
+    return DataBatch(x=P(BATCH_AXES), y=P(BATCH_AXES), w=P(BATCH_AXES))
+
+
+def create_sharded_state(
+    spec,
+    mesh: Mesh,
+    rng: jax.Array,
+    sample_x: jax.Array,
+    tx: Optional[optax.GradientTransformation] = None,
+    rules: Optional[Callable] = None,
+) -> Tuple[TrainState, Any]:
+    """Initialize params DIRECTLY into their target shardings: init is
+    jitted with out_shardings from the rules, so no host-side full
+    materialization ever happens (the driver-OOM-avoidance property of
+    the reference's lazy mode, README.md:115-132, done at the XLA
+    level)."""
+    tx = tx or spec.make_optimizer()
+    module = spec.make_module()
+    rules = rules or transformer_rules(mesh)
+
+    # The init trace runs the full forward (incl. any shard_map
+    # island), so the sample batch must divide across the batch axes.
+    import numpy as np
+
+    n_batch_shards = 1
+    for ax in BATCH_AXES:
+        n_batch_shards *= mesh.shape[ax]
+    sample_x = np.asarray(sample_x)
+    if sample_x.shape[0] % n_batch_shards != 0:
+        reps = -(-n_batch_shards // sample_x.shape[0])
+        sample_x = np.tile(sample_x, (reps,) + (1,) * (sample_x.ndim - 1))[
+            :n_batch_shards
+        ]
+
+    # Everything under set_mesh: tracing the module may hit the ring-
+    # attention shard_map island, which resolves the ambient mesh.
+    with jax.set_mesh(mesh):
+        abstract = jax.eval_shape(lambda k: module.init(k, sample_x), rng)
+        a_params, a_state = _split_variables(abstract)
+        param_sh = shard_params(a_params, mesh, rules)
+        state_sh = jax.tree.map(lambda _: replicated(mesh), a_state)
+
+        def init_all(key):
+            variables = module.init(key, sample_x)
+            params, mstate = _split_variables(variables)
+            opt_state = tx.init(params)
+            return params, mstate, opt_state
+
+        a_opt = jax.eval_shape(lambda k: init_all(k)[2], rng)
+        opt_sh = _opt_state_shardings(a_opt, a_params, param_sh, mesh)
+
+        params, mstate, opt_state = jax.jit(
+            init_all, out_shardings=(param_sh, state_sh, opt_sh)
+        )(rng)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=mstate,
+        opt_state=opt_state,
+        rng=rng,
+    )
+    shardings = TrainState(
+        step=replicated(mesh),
+        params=param_sh,
+        model_state=state_sh,
+        opt_state=opt_sh,
+        rng=replicated(mesh),
+    )
+    return state, shardings
+
+
+def _opt_state_shardings(a_opt, a_params, param_sh, mesh: Mesh):
+    """Optimizer-state leaves that mirror a param leaf (same shape)
+    inherit its sharding; scalars/others replicate. Keeps Adam moments
+    sharded like their params (fsdp/tp) — the memory win that matters."""
+    shape_map = {}
+    for leaf, sh in zip(jax.tree.leaves(a_params), jax.tree.leaves(param_sh)):
+        shape_map.setdefault((tuple(leaf.shape), str(leaf.dtype)), sh)
+
+    def pick(leaf):
+        key = (tuple(getattr(leaf, "shape", ())), str(getattr(leaf, "dtype", "")))
+        return shape_map.get(key, replicated(mesh))
+
+    return jax.tree.map(pick, a_opt)
+
+
+def make_sharded_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_shardings: TrainState,
+    seq_sharded: bool = False,
+) -> Callable[[TrainState, DataBatch], Tuple[TrainState, StepMetrics]]:
+    """One GSPMD train step: global weighted-mean loss and grads; XLA
+    derives every collective from the shardings."""
+
+    def step(state: TrainState, batch: DataBatch):
+        def weighted_mean_loss(params):
+            variables = {"params": params, **state.model_state}
+            if state.model_state:
+                preds, new_state = apply_fn(
+                    variables, batch.x, mutable=list(state.model_state.keys())
+                )
+            else:
+                preds, new_state = apply_fn(variables, batch.x), state.model_state
+            per = loss_fn(preds, batch.y)
+            num = jnp.sum(per * batch.w)
+            den = jnp.maximum(jnp.sum(batch.w), 1.0)
+            return num / den, (den, new_state)
+
+        (loss, (den, new_model_state)), grads = jax.value_and_grad(
+            weighted_mean_loss, has_aux=True
+        )(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt,
+            rng=state.rng,
+        )
+        metrics = StepMetrics(
+            loss=loss, examples=den, grad_norm=optax.global_norm(grads)
+        )
+        return new_state, metrics
+
+    b_specs = batch_specs(seq_sharded)
+    in_shardings = (
+        state_shardings,
+        DataBatch(*(NamedSharding(mesh, s) for s in b_specs)),
+    )
+    jitted = jax.jit(
+        step,
+        in_shardings=in_shardings,
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+
+    def run(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    return run
+
+
+def shard_batch(batch: DataBatch, mesh: Mesh, seq_sharded: bool = False) -> DataBatch:
+    specs = batch_specs(seq_sharded)
+    return DataBatch(
+        *(jax.device_put(a, NamedSharding(mesh, s)) for a, s in zip(batch, specs))
+    )

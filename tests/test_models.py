@@ -1,0 +1,109 @@
+"""Model-family coverage: ResNet (BN batch_stats path through the
+generic trainers) and transformer classifier through the Estimator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparktorch_tpu import SparkTorch, serialize_torch_obj, serialize_torch_obj_lazy
+from sparktorch_tpu.models import resnet18, resnet50, tiny_transformer, SequenceClassifier
+from sparktorch_tpu.models.resnet import ResNet, ResNetBlock
+from sparktorch_tpu.train.sync import train_distributed
+
+
+def _tiny_resnet(num_classes=2):
+    # Small-width ResNet keeps CPU tests fast while exercising the
+    # real block/BN structure.
+    return ResNet(stage_sizes=(1, 1), block_cls=ResNetBlock, width=8,
+                  num_classes=num_classes, input_hw=(8, 8, 1))
+
+
+def test_resnet_batch_stats_sync_training():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (64, 64)).astype(np.float32)  # flat 8x8 rows
+    y = (x.mean(axis=1) > 0).astype(np.int64)
+    payload = serialize_torch_obj(
+        _tiny_resnet(), criterion="cross_entropy", optimizer="adam",
+        optimizer_params={"lr": 1e-2}, input_shape=(64,),
+    )
+    result = train_distributed(payload, x, labels=y, iters=8)
+    # BN means/vars must exist, be finite, and have been updated.
+    stats = jax.tree.leaves(result.model_state)
+    assert stats, "batch_stats collection missing"
+    assert all(np.all(np.isfinite(np.asarray(s))) for s in stats)
+    losses = [m["loss"] for m in result.metrics]
+    assert losses[-1] < losses[0]
+
+
+def test_resnet_inference_uses_running_stats():
+    # Plain apply (no mutable) must run in eval mode (running stats),
+    # so two calls on different batches of the same trained model with
+    # identical inputs agree.
+    module = _tiny_resnet()
+    x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (4, 64)), jnp.float32)
+    variables = module.init(jax.random.key(0), x)
+    out1 = module.apply(variables, x)
+    out2 = module.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+    # And mutable apply returns updated stats.
+    out3, updated = module.apply(variables, x, mutable=["batch_stats"])
+    changed = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(variables["batch_stats"]),
+            jax.tree.leaves(updated["batch_stats"]),
+        )
+    )
+    assert changed
+
+
+def test_resnet18_50_shapes():
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    m18 = resnet18(num_classes=10, width=8)
+    v = m18.init(jax.random.key(0), x)
+    assert m18.apply(v, x).shape == (2, 10)
+
+    m50 = resnet50(num_classes=10, width=8)
+    x224 = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    v50 = m50.init(jax.random.key(0), x224)
+    assert m50.apply(v50, x224).shape == (1, 10)
+
+
+def test_transformer_through_estimator(data):
+    # Token-style input built from the blob features (cast to ids).
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32, (120, 12)).astype(np.float32)
+    labels = (ids[:, 0] > 15).astype(np.float32)
+    cfg = tiny_transformer(vocab_size=32, d_model=32, n_heads=2, n_layers=1,
+                           d_ff=64, max_len=12)
+    payload = serialize_torch_obj(
+        SequenceClassifier(cfg), criterion="cross_entropy", optimizer="adam",
+        optimizer_params={"lr": 5e-3}, input_shape=(12,),
+    )
+    est = SparkTorch(inputCol="features", labelCol="label",
+                     predictionCol="predictions", torchObj=payload, iters=30)
+    df = {"features": list(ids), "label": labels}
+    model = est.fit(df)
+    res = model.transform(df)
+    rows = res.collect()
+    acc = np.mean([float(r["predictions"]) == float(r["label"]) for r in rows])
+    assert acc > 0.8, acc
+
+
+def test_resnet_lazy_serialization():
+    # Lazy path with ctor kwargs (the driver-OOM-avoidance property).
+    payload = serialize_torch_obj_lazy(
+        ResNet, criterion="cross_entropy", optimizer="sgd",
+        optimizer_params={"lr": 0.1},
+        model_parameters=dict(stage_sizes=(1, 1), block_cls=ResNetBlock,
+                              width=8, num_classes=2, input_hw=(8, 8, 1)),
+        input_shape=(64,),
+    )
+    from sparktorch_tpu.utils.serde import deserialize_model, envelope_shapes
+
+    shapes = envelope_shapes(payload)
+    assert shapes  # abstract shape recording traced BN stats too
+    spec = deserialize_model(payload)
+    variables = spec.init_params(jax.random.key(0))
+    assert "batch_stats" in variables

@@ -1,0 +1,106 @@
+"""GSPMD sharded trainer: transformer over dp x tp x sp meshes,
+dense vs ring attention, param layouts actually sharded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sparktorch_tpu.models import CausalLM, SequenceClassifier, tiny_transformer
+from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+from sparktorch_tpu.parallel.sharding_rules import shard_params, transformer_rules
+from sparktorch_tpu.train.sharded import (
+    create_sharded_state,
+    make_sharded_train_step,
+    shard_batch,
+)
+from sparktorch_tpu.utils.data import DataBatch
+from sparktorch_tpu.utils.serde import ModelSpec
+
+
+def _lm_batch(b=8, s=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (b, s + 1)).astype(np.int32)
+    return DataBatch(
+        x=jnp.asarray(ids[:, :-1]),
+        y=jnp.asarray(ids[:, 1:]),
+        w=jnp.ones((b,), jnp.float32),
+    )
+
+
+def _cls_batch(b=8, s=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataBatch(
+        x=jnp.asarray(rng.integers(0, vocab, (b, s)).astype(np.int32)),
+        y=jnp.asarray(rng.integers(0, 2, (b,)).astype(np.int32)),
+        w=jnp.ones((b,), jnp.float32),
+    )
+
+
+def _run_steps(mesh, module, batch, seq_sharded, n_steps=3, loss="cross_entropy"):
+    spec = ModelSpec(module=module, loss=loss, optimizer="adam",
+                     optimizer_params={"lr": 1e-3})
+    tx = spec.make_optimizer()
+    state, shardings = create_sharded_state(
+        spec, mesh, jax.random.key(0), sample_x=np.asarray(batch.x[:1]), tx=tx
+    )
+    step = make_sharded_train_step(
+        module.apply, spec.loss_fn(), tx, mesh, shardings, seq_sharded=seq_sharded
+    )
+    batch = shard_batch(batch, mesh, seq_sharded)
+    losses = []
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics.loss))
+    return state, losses
+
+
+def test_classifier_dp_tp():
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    module = SequenceClassifier(tiny_transformer())
+    state, losses = _run_steps(mesh, module, _cls_batch(), seq_sharded=False)
+    assert all(np.isfinite(losses)), losses
+    # tp=4 must actually shard the mlp kernels.
+    mlp_kernel = state.params["backbone"]["layer_0"]["mlp_in"]["kernel"]
+    spec = mlp_kernel.sharding.spec
+    assert "tp" in str(spec), spec
+
+
+def test_causal_lm_ring_vs_dense_parity():
+    """Ring attention under sp=4 must produce the same training
+    trajectory as dense attention on the same data."""
+    batch = _lm_batch()
+    cfg_d = tiny_transformer(attn_impl="dense")
+    cfg_r = tiny_transformer(attn_impl="ring")
+
+    mesh_dense = build_mesh(MeshConfig(dp=8, sp=1))
+    _, losses_dense = _run_steps(mesh_dense, CausalLM(cfg_d), batch, seq_sharded=False)
+
+    mesh_ring = build_mesh(MeshConfig(dp=2, sp=4))
+    _, losses_ring = _run_steps(mesh_ring, CausalLM(cfg_r), batch, seq_sharded=True)
+
+    np.testing.assert_allclose(losses_dense, losses_ring, rtol=2e-3)
+
+
+def test_lm_loss_decreases_dp_fsdp_tp():
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    module = CausalLM(tiny_transformer())
+    _, losses = _run_steps(mesh, module, _lm_batch(), seq_sharded=False, n_steps=10)
+    assert losses[-1] < losses[0], losses
+
+
+def test_shard_params_rules():
+    # tp=4 matches the tiny config's 4 heads; an axis that does not
+    # divide a dim (e.g. tp=8 over 4 heads) falls back to fsdp/replica.
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    module = SequenceClassifier(tiny_transformer())
+    abstract = jax.eval_shape(
+        lambda k: module.init(k, jnp.zeros((1, 16), jnp.int32)),
+        jax.random.key(0),
+    )["params"]
+    shardings = shard_params(abstract, mesh, transformer_rules(mesh))
+    qkv = shardings["backbone"]["layer_0"]["attn"]["qkv"]["kernel"]
+    assert "tp" in str(qkv.spec)
+    proj = shardings["backbone"]["layer_0"]["attn"]["proj"]["kernel"]
+    assert str(proj.spec).startswith("PartitionSpec('tp'")
