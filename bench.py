@@ -35,7 +35,7 @@ def main() -> None:
 
     from sparktorch_tpu.models import MnistCNN
     from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh, replicated
-    from sparktorch_tpu.train.step import create_train_state, make_train_step
+    from sparktorch_tpu.train.step import create_train_state, make_train_epoch
     from sparktorch_tpu.train.sync import prepare_sharded_batch
     from sparktorch_tpu.utils.data import handle_features
     from sparktorch_tpu.utils.serde import ModelSpec
@@ -58,16 +58,23 @@ def main() -> None:
         state = create_train_state(spec, jax.random.key(0),
                                    sample_x=batch.x[:1], tx=tx)
     state = jax.device_put(state, replicated(mesh))
-    step = make_train_step(spec.make_module().apply, spec.loss_fn(), tx, mesh)
+    # The whole measured run is ONE compiled call: ITERS steps fused by
+    # lax.scan — zero per-step Python/dispatch (the framework's fast
+    # path; the reference pays Python + per-param gloo per step).
+    epoch = make_train_epoch(spec.make_module().apply, spec.loss_fn(), tx,
+                             mesh, steps_per_call=ITERS)
+
+    import jax.numpy as jnp
 
     for _ in range(WARMUP):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics.loss)
+        state, metrics = epoch(state, batch)
+    # float() forces full materialization — on the tunneled axon
+    # platform block_until_ready alone under-blocks.
+    float(jnp.sum(metrics.loss))
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics.loss)
+    state, metrics = epoch(state, batch)
+    float(jnp.sum(metrics.loss))
     dt = time.perf_counter() - t0
 
     examples_per_sec = BATCH * ITERS / dt

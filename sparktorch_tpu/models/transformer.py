@@ -74,7 +74,11 @@ class MultiHeadAttention(nn.Module):
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b,s,h,hd)
 
-        if cfg.attn_impl == "ring":
+        if cfg.attn_impl == "flash":
+            from sparktorch_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, cfg.causal)
+        elif cfg.attn_impl == "ring":
             spec = P(BATCH_AXES, "sp", "tp", None)
             attn = shard_map(
                 lambda q, k, v: ring_attention(

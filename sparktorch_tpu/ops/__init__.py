@@ -1,3 +1,11 @@
 from sparktorch_tpu.ops.attention import dense_attention, ring_attention
+from sparktorch_tpu.ops.flash_attention import flash_attention
+from sparktorch_tpu.ops.fused_ce import fused_cross_entropy, fused_cross_entropy_loss
 
-__all__ = ["dense_attention", "ring_attention"]
+__all__ = [
+    "dense_attention",
+    "ring_attention",
+    "flash_attention",
+    "fused_cross_entropy",
+    "fused_cross_entropy_loss",
+]

@@ -1,0 +1,132 @@
+"""Fused softmax cross-entropy in Pallas.
+
+For LM training the naive path materializes (tokens, vocab) softmax
+probabilities in HBM. This kernel streams vocab blocks through VMEM,
+carrying a running (max, sum-exp, picked-logit) per token — the loss
+comes out without the probability matrix ever existing. Backward uses
+the analytic gradient (softmax - onehot), which XLA fuses well.
+
+grid = (token_blocks, vocab_blocks); innermost axis iterates
+sequentially so VMEM scratch accumulates across vocab blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_LANES = 128
+
+
+def _ce_kernel(logits_ref, labels_ref, loss_ref, m_ref, l_ref, p_ref,
+               *, block_v: int, n_v: int):
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        p_ref[:] = jnp.zeros_like(p_ref)
+
+    s = logits_ref[:].astype(jnp.float32)  # (block_t, block_v)
+    labels = labels_ref[:, :1]  # (block_t, 1) int32
+    col = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_ref[:, :1] + jnp.sum(jnp.exp(s - m_new), axis=-1,
+                                           keepdims=True)
+    hit = col == labels
+    picked = jnp.sum(jnp.where(hit, s, 0.0), axis=-1, keepdims=True)
+    p_ref[:] = p_ref[:] + jnp.broadcast_to(picked, p_ref.shape)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(vi == n_v - 1)
+    def _finalize():
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        loss_ref[:] = jnp.broadcast_to(lse - p_ref[:, :1], loss_ref.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    block_t: int = 256,
+    block_v: int = 512,
+) -> jax.Array:
+    """Per-token CE loss. logits (tokens, vocab), labels (tokens,)
+    int. Returns (tokens,) float32."""
+    return _ce_impl(logits, labels, block_t, block_v)
+
+
+def _ce_impl(logits, labels, block_t, block_v):
+    t, v = logits.shape
+    block_t = min(block_t, t)
+    block_v = min(block_v, v)
+    if t % block_t or v % block_v or pltpu is None:
+        logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[:, None].astype(jnp.int32), axis=-1
+        )[:, 0]
+        return logz - picked
+
+    interpret = jax.default_backend() != "tpu"
+    labels2 = jnp.broadcast_to(labels.astype(jnp.int32)[:, None], (t, _LANES))
+    n_v = v // block_v
+    kernel = functools.partial(_ce_kernel, block_v=block_v, n_v=n_v)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((t, _LANES), jnp.float32),
+        grid=(t // block_t, n_v),
+        in_specs=[
+            pl.BlockSpec((block_t, block_v), lambda ti, vi: (ti, vi)),
+            pl.BlockSpec((block_t, _LANES), lambda ti, vi: (ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, _LANES), lambda ti, vi: (ti, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_t, _LANES), jnp.float32),
+            pltpu.VMEM((block_t, _LANES), jnp.float32),
+            pltpu.VMEM((block_t, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, labels2)
+    return out[:, 0]
+
+
+def _ce_fwd(logits, labels, block_t, block_v):
+    return _ce_impl(logits, labels, block_t, block_v), (logits, labels)
+
+
+def _ce_bwd(block_t, block_v, res, g):
+    logits, labels = res
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels.astype(jnp.int32), logits.shape[-1],
+                            dtype=jnp.float32)
+    grad = (probs - onehot) * g[:, None]
+    return grad.astype(logits.dtype), None
+
+
+fused_cross_entropy.defvjp(_ce_fwd, _ce_bwd)
+
+
+def fused_cross_entropy_loss(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """Registry-compatible loss: handles (batch, vocab) or
+    (batch, seq, vocab) logits, returns per-example loss (batch,)."""
+    labels = targets.astype(jnp.int32)
+    if preds.ndim == 2:
+        return fused_cross_entropy(preds, labels)
+    b = preds.shape[0]
+    flat = preds.reshape(-1, preds.shape[-1])
+    per_token = fused_cross_entropy(flat, labels.reshape(-1))
+    return per_token.reshape(b, -1).mean(axis=-1)

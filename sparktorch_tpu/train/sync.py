@@ -28,6 +28,7 @@ from sparktorch_tpu.train.step import (
     TrainState,
     create_train_state,
     make_eval_step,
+    make_train_epoch,
     make_train_step,
 )
 from sparktorch_tpu.utils.data import DataBatch, handle_features, pad_to_multiple
@@ -92,6 +93,7 @@ def train_distributed(
     seed: int = 0,
     device: Optional[str] = None,  # accepted for API parity; mesh decides
     metrics_hook: Optional[Callable[[dict], None]] = None,
+    steps_per_call: Optional[int] = None,
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
@@ -122,17 +124,34 @@ def train_distributed(
 
     loss_fn = spec.loss_fn()
     module = spec.make_module()
-    train_step = make_train_step(
-        module.apply, loss_fn, tx, mesh, mini_batch=mini_batch
-    )
-    eval_step = (
-        make_eval_step(module.apply, loss_fn, mesh) if val_batch is not None else None
-    )
 
     stopper = (
         EarlyStopping(patience=early_stop_patience)
         if early_stop_patience is not None and early_stop_patience > 0
         else None
+    )
+    # Fast path: fuse many steps into one compiled call (lax.scan) when
+    # nothing needs per-step host decisions. Early stopping and the
+    # per-iter val forward keep exact reference semantics on the
+    # step-per-call path.
+    if steps_per_call is None:
+        steps_per_call = 1 if (stopper is not None or val_batch is not None) else min(iters, 32)
+    steps_per_call = max(1, min(steps_per_call, iters))
+    # Chunks must divide iters exactly (a fused call always runs its
+    # full scan; overshooting would silently train extra steps).
+    while iters % steps_per_call != 0:
+        steps_per_call -= 1
+
+    if steps_per_call > 1:
+        train_step = make_train_epoch(
+            module.apply, loss_fn, tx, mesh, steps_per_call, mini_batch=mini_batch
+        )
+    else:
+        train_step = make_train_step(
+            module.apply, loss_fn, tx, mesh, mini_batch=mini_batch
+        )
+    eval_step = (
+        make_eval_step(module.apply, loss_fn, mesh) if val_batch is not None else None
     )
 
     metrics: list = []
@@ -142,41 +161,66 @@ def train_distributed(
             shuffle_key, sub = jax.random.split(shuffle_key)
             train_batch = _shuffle_batch(train_batch, sub, mesh)
         stop = False
-        for i in range(iters):
+        i = 0
+        while i < iters:
             t0 = time.perf_counter()
-            state, step_metrics = train_step(state, train_batch)
-            loss = float(step_metrics.loss)  # blocks; also the stop signal
-            dt = time.perf_counter() - t0
-            val_loss = (
-                float(eval_step(state, val_batch)) if eval_step is not None else None
-            )
-            record = {
-                "round": shuffle_round,
-                "iter": i,
-                "loss": loss,
-                "val_loss": val_loss,
-                "examples": float(step_metrics.examples),
-                "grad_norm": float(step_metrics.grad_norm),
-                "step_time_s": dt,
-            }
-            metrics.append(record)
-            if metrics_hook:
-                metrics_hook(record)
-            if verbose:
-                # Reference prints per-partition loss lines
-                # (distributed.py:201-204); here one global line.
-                msg = f"[sparktorch_tpu] round {shuffle_round} iter {i} loss {loss:.6f}"
-                if val_loss is not None:
-                    msg += f" val_loss {val_loss:.6f}"
-                print(msg)
-            # Early stop needs no collective: `loss` is already the
-            # global mean, identical on every host (vs the reference's
-            # two extra all_reduces, distributed.py:186-197).
-            if stopper is not None:
-                signal = val_loss if val_loss is not None else loss
-                if stopper.step(signal):
-                    stop = True
-                    break
+            if steps_per_call > 1:
+                n = min(steps_per_call, iters - i)
+                state, stacked = train_step(state, train_batch)
+                losses = np.asarray(stacked.loss)[:n]
+                examples = np.asarray(stacked.examples)[:n]
+                gnorms = np.asarray(stacked.grad_norm)[:n]
+                dt = (time.perf_counter() - t0) / n
+                chunk = [
+                    (float(l), float(e), float(g))
+                    for l, e, g in zip(losses, examples, gnorms)
+                ]
+            else:
+                state, step_metrics = train_step(state, train_batch)
+                chunk = [(
+                    float(step_metrics.loss),
+                    float(step_metrics.examples),
+                    float(step_metrics.grad_norm),
+                )]
+                dt = time.perf_counter() - t0
+
+            for loss, examples_n, gnorm in chunk:
+                val_loss = (
+                    float(eval_step(state, val_batch))
+                    if eval_step is not None and steps_per_call == 1
+                    else None
+                )
+                record = {
+                    "round": shuffle_round,
+                    "iter": i,
+                    "loss": loss,
+                    "val_loss": val_loss,
+                    "examples": examples_n,
+                    "grad_norm": gnorm,
+                    "step_time_s": dt,
+                }
+                metrics.append(record)
+                if metrics_hook:
+                    metrics_hook(record)
+                if verbose:
+                    # Reference prints per-partition loss lines
+                    # (distributed.py:201-204); here one global line.
+                    msg = f"[sparktorch_tpu] round {shuffle_round} iter {i} loss {loss:.6f}"
+                    if val_loss is not None:
+                        msg += f" val_loss {val_loss:.6f}"
+                    print(msg)
+                # Early stop needs no collective: `loss` is already the
+                # global mean, identical on every host (vs the
+                # reference's two extra all_reduces,
+                # distributed.py:186-197).
+                if stopper is not None:
+                    signal = val_loss if val_loss is not None else loss
+                    if stopper.step(signal):
+                        stop = True
+                        break
+                i += 1
+            if stop:
+                break
         if stop:
             break
 
