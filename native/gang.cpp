@@ -1,0 +1,324 @@
+// Gang coordinator: TCP rendezvous + barrier + heartbeat failure
+// detection for multi-host TPU training.
+//
+// Role in the framework: the reference delegates gang scheduling to
+// Spark's JVM barrier executor (PipelinedRDD(..., isFromBarrier=True),
+// reference distributed.py:39-43) and rendezvous to gloo's TCP store on
+// a hardcoded driver port (distributed.py:101-105). This library is the
+// native replacement: the driver runs a coordinator; each host process
+// registers (rank, address), blocks on a barrier until the world is
+// complete, retrieves the peer table (whose rank-0 address seeds
+// jax.distributed.initialize), and then heartbeats. A silent host is
+// declared dead after a timeout and every barrier waiter is released
+// with an error — failure *detection*, which the reference lacks
+// entirely (SURVEY section 5: resilience is one HTTP retry).
+//
+// Exposed as a C API for ctypes (no pybind11 in this toolchain).
+//
+// Protocol (line-based over TCP):
+//   REG <rank> <addr>\n   -> OK <world_size>\n | ERR <msg>\n
+//   BAR <epoch>\n         -> GO\n | DEAD\n
+//   WLD\n                 -> <rank0 addr>,<rank1 addr>,...\n
+//   HB <rank>\n           -> OK\n | DEAD\n
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct GangState {
+  int world_size = 0;
+  int heartbeat_timeout_ms = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<int, std::string> members;         // rank -> addr
+  std::map<int, Clock::time_point> last_beat; // rank -> last heartbeat
+  std::map<long, int> barrier_count;          // epoch -> arrivals
+  std::atomic<bool> failed{false};
+  std::atomic<int> dead_rank{-1};
+  std::atomic<bool> running{true};
+};
+
+struct GangServer {
+  int listen_fd = -1;
+  int port = 0;
+  GangState state;
+  std::thread accept_thread;
+  std::thread monitor_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex conn_mu;
+};
+
+bool read_line(int fd, std::string *out) {
+  out->clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    out->push_back(c);
+    if (out->size() > 4096) return false;
+  }
+}
+
+bool write_all(int fd, const std::string &s) {
+  size_t off = 0;
+  while (off < s.size()) {
+    ssize_t n = send(fd, s.data() + off, s.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+void handle_conn(GangServer *srv, int fd) {
+  GangState &st = srv->state;
+  std::string line;
+  while (st.running.load() && read_line(fd, &line)) {
+    if (line.rfind("REG ", 0) == 0) {
+      int rank = -1;
+      char addr[1024] = {0};
+      if (sscanf(line.c_str(), "REG %d %1023s", &rank, addr) != 2 ||
+          rank < 0 || rank >= st.world_size) {
+        write_all(fd, "ERR bad rank\n");
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> lock(st.mu);
+        st.members[rank] = addr;
+        st.last_beat[rank] = Clock::now();
+      }
+      st.cv.notify_all();
+      write_all(fd, "OK " + std::to_string(st.world_size) + "\n");
+    } else if (line.rfind("BAR ", 0) == 0) {
+      long epoch = atol(line.c_str() + 4);
+      std::unique_lock<std::mutex> lock(st.mu);
+      st.barrier_count[epoch]++;
+      st.cv.notify_all();
+      st.cv.wait(lock, [&] {
+        return st.barrier_count[epoch] >= st.world_size ||
+               st.failed.load() || !st.running.load();
+      });
+      lock.unlock();
+      write_all(fd, st.failed.load() ? "DEAD\n" : "GO\n");
+    } else if (line.rfind("HB ", 0) == 0) {
+      int rank = atoi(line.c_str() + 3);
+      {
+        std::lock_guard<std::mutex> lock(st.mu);
+        st.last_beat[rank] = Clock::now();
+      }
+      write_all(fd, st.failed.load() ? "DEAD\n" : "OK\n");
+    } else if (line == "WLD") {
+      std::string out;
+      {
+        std::lock_guard<std::mutex> lock(st.mu);
+        for (auto &kv : st.members) {
+          if (!out.empty()) out += ",";
+          out += kv.second;
+        }
+      }
+      write_all(fd, out + "\n");
+    } else {
+      write_all(fd, "ERR unknown\n");
+    }
+  }
+  close(fd);
+}
+
+void monitor_loop(GangServer *srv) {
+  GangState &st = srv->state;
+  if (st.heartbeat_timeout_ms <= 0) return;
+  while (st.running.load()) {
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(st.heartbeat_timeout_ms / 4 + 1));
+    auto now = Clock::now();
+    std::lock_guard<std::mutex> lock(st.mu);
+    // Only monitor once the full gang registered — a slow joiner is
+    // not a failure (registration has its own timeout client-side).
+    if (static_cast<int>(st.members.size()) < st.world_size) continue;
+    for (auto &kv : st.last_beat) {
+      auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    now - kv.second)
+                    .count();
+      if (ms > st.heartbeat_timeout_ms) {
+        st.failed.store(true);
+        st.dead_rank.store(kv.first);
+        st.cv.notify_all();
+      }
+    }
+  }
+}
+
+void accept_loop(GangServer *srv) {
+  while (srv->state.running.load()) {
+    int fd = accept(srv->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (!srv->state.running.load()) break;
+      continue;
+    }
+    std::lock_guard<std::mutex> lock(srv->conn_mu);
+    srv->conn_threads.emplace_back(handle_conn, srv, fd);
+  }
+}
+
+struct GangClient {
+  int fd = -1;
+  int rank = -1;
+};
+
+int dial(const char *host, int port, int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *gang_server_start(int port, int world_size, int heartbeat_timeout_ms) {
+  auto *srv = new GangServer();
+  srv->state.world_size = world_size;
+  srv->state.heartbeat_timeout_ms = heartbeat_timeout_ms;
+  srv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv->listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) != 0 ||
+      listen(srv->listen_fd, 128) != 0) {
+    close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t len = sizeof(sa);
+  getsockname(srv->listen_fd, reinterpret_cast<sockaddr *>(&sa), &len);
+  srv->port = ntohs(sa.sin_port);
+  srv->accept_thread = std::thread(accept_loop, srv);
+  srv->monitor_thread = std::thread(monitor_loop, srv);
+  return srv;
+}
+
+int gang_server_port(void *p) { return static_cast<GangServer *>(p)->port; }
+
+int gang_server_failed(void *p) {
+  return static_cast<GangServer *>(p)->state.failed.load() ? 1 : 0;
+}
+
+int gang_server_dead_rank(void *p) {
+  return static_cast<GangServer *>(p)->state.dead_rank.load();
+}
+
+int gang_server_registered(void *p) {
+  auto *srv = static_cast<GangServer *>(p);
+  std::lock_guard<std::mutex> lock(srv->state.mu);
+  return static_cast<int>(srv->state.members.size());
+}
+
+void gang_server_stop(void *p) {
+  auto *srv = static_cast<GangServer *>(p);
+  srv->state.running.store(false);
+  srv->state.cv.notify_all();
+  shutdown(srv->listen_fd, SHUT_RDWR);
+  close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  if (srv->monitor_thread.joinable()) srv->monitor_thread.join();
+  {
+    std::lock_guard<std::mutex> lock(srv->conn_mu);
+    for (auto &t : srv->conn_threads)
+      if (t.joinable()) t.join();
+  }
+  delete srv;
+}
+
+void *gang_client_connect(const char *host, int port, int rank,
+                          const char *addr, int timeout_ms) {
+  int fd = dial(host, port, timeout_ms);
+  if (fd < 0) return nullptr;
+  auto *cli = new GangClient{fd, rank};
+  std::string msg = "REG " + std::to_string(rank) + " " + addr + "\n";
+  std::string resp;
+  if (!write_all(fd, msg) || !read_line(fd, &resp) ||
+      resp.rfind("OK", 0) != 0) {
+    close(fd);
+    delete cli;
+    return nullptr;
+  }
+  return cli;
+}
+
+// 0 = released, 1 = gang failure (a member died), -1 = io error.
+int gang_client_barrier(void *p, long epoch) {
+  auto *cli = static_cast<GangClient *>(p);
+  std::string resp;
+  if (!write_all(cli->fd, "BAR " + std::to_string(epoch) + "\n")) return -1;
+  // Barrier waits indefinitely server-side; disable the rcv timeout
+  // for this read and restore afterwards is overkill — poll lines.
+  struct timeval tv {};
+  tv.tv_sec = 86400;
+  setsockopt(cli->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  if (!read_line(cli->fd, &resp)) return -1;
+  return resp == "GO" ? 0 : 1;
+}
+
+int gang_client_heartbeat(void *p) {
+  auto *cli = static_cast<GangClient *>(p);
+  std::string resp;
+  if (!write_all(cli->fd, "HB " + std::to_string(cli->rank) + "\n")) return -1;
+  if (!read_line(cli->fd, &resp)) return -1;
+  return resp == "OK" ? 0 : 1;
+}
+
+int gang_client_world(void *p, char *buf, int buflen) {
+  auto *cli = static_cast<GangClient *>(p);
+  std::string resp;
+  if (!write_all(cli->fd, "WLD\n")) return -1;
+  if (!read_line(cli->fd, &resp)) return -1;
+  if (static_cast<int>(resp.size()) + 1 > buflen) return -1;
+  memcpy(buf, resp.c_str(), resp.size() + 1);
+  return static_cast<int>(resp.size());
+}
+
+void gang_client_close(void *p) {
+  auto *cli = static_cast<GangClient *>(p);
+  close(cli->fd);
+  delete cli;
+}
+
+}  // extern "C"

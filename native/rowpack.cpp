@@ -1,0 +1,160 @@
+// rowpack: multithreaded CSV -> float32 matrix parser.
+//
+// Role in the framework: the reference's data path goes Spark row ->
+// per-row numpy conversion -> python stacking (handle_data,
+// reference torch_distributed.py:43-55; handle_features util.py:57-100)
+// and its examples ingest MNIST CSVs through Spark's reader. This is
+// the native ingestion fast path: memory-map-free chunked reads,
+// one worker thread per chunk, straight into a caller-allocated
+// float32 buffer. Label column extraction is fused into the same scan.
+//
+// C API (ctypes):
+//   rowpack_count(path, *rows, *cols)          -> 0 ok
+//   rowpack_parse(path, out, rows, cols,
+//                 label_col, labels_out, nthreads) -> rows parsed (<0 err)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Count data rows and columns of a CSV (header detected by presence
+// of a non-numeric first field).
+int scan_dims(const char *path, long *rows, int *cols, long *data_start) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  std::string line;
+  char buf[1 << 16];
+  long r = 0;
+  int c = 0;
+  long offset = 0;
+  *data_start = 0;
+  bool first = true;
+  while (fgets(buf, sizeof(buf), f)) {
+    size_t len = strlen(buf);
+    if (first) {
+      // Column count from the first line.
+      c = 1;
+      for (size_t i = 0; i < len; i++)
+        if (buf[i] == ',') c++;
+      // Header? first char not numeric/[-+.].
+      char ch = buf[0];
+      bool header = !(ch == '-' || ch == '+' || ch == '.' ||
+                      (ch >= '0' && ch <= '9'));
+      if (header) *data_start = static_cast<long>(len);
+      else r++;
+      first = false;
+    } else if (len > 1) {
+      r++;
+    }
+    offset += static_cast<long>(len);
+  }
+  fclose(f);
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
+void parse_chunk(const char *data, size_t begin, size_t end, size_t total,
+                 long row_begin, int cols, int label_col, float *out,
+                 float *labels) {
+  // Advance to the start of the next full line unless at a boundary.
+  size_t pos = begin;
+  if (pos != 0) {
+    while (pos < end && data[pos - 1] != '\n') pos++;
+  }
+  long row = row_begin;
+  while (pos < total && pos < end) {
+    // Parse one line.
+    int col = 0, out_col = 0;
+    const char *p = data + pos;
+    char *next = nullptr;
+    while (col < cols) {
+      float v = strtof(p, &next);
+      if (next == p) break;
+      if (col == label_col && labels) {
+        labels[row] = v;
+      } else {
+        out[row * (label_col >= 0 ? cols - 1 : cols) + out_col] = v;
+        out_col++;
+      }
+      p = next;
+      if (*p == ',') p++;
+      col++;
+    }
+    while (pos < total && data[pos] != '\n') pos++;
+    pos++;  // past newline
+    row++;
+  }
+}
+
+// Row index at a byte offset: count newlines before it.
+long rows_before(const char *data, size_t upto) {
+  long n = 0;
+  for (size_t i = 0; i < upto; i++)
+    if (data[i] == '\n') n++;
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+int rowpack_count(const char *path, long *rows, int *cols) {
+  long ds;
+  return scan_dims(path, rows, cols, &ds);
+}
+
+long rowpack_parse(const char *path, float *out, long rows, int cols,
+                   int label_col, float *labels, int nthreads) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> data(static_cast<size_t>(size) + 1);
+  if (fread(data.data(), 1, static_cast<size_t>(size), f) !=
+      static_cast<size_t>(size)) {
+    fclose(f);
+    return -1;
+  }
+  fclose(f);
+  data[static_cast<size_t>(size)] = '\0';
+
+  // Skip a header line if present.
+  size_t start = 0;
+  char ch = data[0];
+  if (!(ch == '-' || ch == '+' || ch == '.' || (ch >= '0' && ch <= '9'))) {
+    while (start < static_cast<size_t>(size) && data[start] != '\n') start++;
+    start++;
+  }
+
+  if (nthreads <= 0) nthreads = static_cast<int>(
+      std::max(1u, std::thread::hardware_concurrency()));
+  size_t span = (static_cast<size_t>(size) - start) /
+                    static_cast<size_t>(nthreads) + 1;
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nthreads; t++) {
+    size_t begin = start + static_cast<size_t>(t) * span;
+    size_t end = std::min(static_cast<size_t>(size), begin + span);
+    if (begin >= static_cast<size_t>(size)) break;
+    // Row index where this chunk's first full line starts.
+    size_t aligned = begin;
+    if (aligned != start) {
+      while (aligned < end && data[aligned - 1] != '\n') aligned++;
+    }
+    long row_begin = rows_before(data.data() + start, aligned - start);
+    workers.emplace_back(parse_chunk, data.data(), begin, end,
+                         static_cast<size_t>(size), row_begin, cols,
+                         label_col, out, labels);
+  }
+  for (auto &w : workers) w.join();
+  return rows;
+}
+
+}  // extern "C"

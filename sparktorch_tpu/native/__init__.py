@@ -1,0 +1,10 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+Libraries are built on demand from ``native/*.cpp`` with the repo's
+Makefile and cached in ``native/build/``. See native/gang.cpp and
+native/rowpack.cpp for what each replaces in the reference.
+"""
+
+from sparktorch_tpu.native.build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,35 @@
+"""Build-on-demand loader for the native libraries."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Load ``lib<name>.so``, compiling it first if missing/stale."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        so_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        src_path = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+        if not os.path.exists(so_path) or (
+            os.path.exists(src_path)
+            and os.path.getmtime(src_path) > os.path.getmtime(so_path)
+        ):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, f"build/lib{name}.so"],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(so_path)
+        _CACHE[name] = lib
+        return lib

@@ -1,0 +1,164 @@
+"""Python API over the native gang coordinator (native/gang.cpp).
+
+Gang scheduling + rendezvous + failure detection for multi-host
+bring-up — the native replacement for the reference's Spark JVM
+barrier stage (``distributed.py:39-43``) and gloo TCP rendezvous on a
+hardcoded port (``distributed.py:101-105``). The typical flow:
+
+    # driver / host 0
+    coord = GangCoordinator(world_size=4)
+    # every host (including 0)
+    worker = GangWorker(coord_host, coord.port, rank, my_addr)
+    worker.barrier(0)                 # gang entry
+    peers = worker.world()            # rank-ordered addresses
+    jax.distributed.initialize(coordinator_address=peers[0], ...)
+
+Heartbeats run on a daemon thread; a dead host flips every barrier
+into a GangFailure, so surviving hosts fail fast instead of hanging
+in an XLA collective.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import List, Optional
+
+from sparktorch_tpu.native.build import load_library
+
+
+class GangFailure(RuntimeError):
+    pass
+
+
+def _lib():
+    lib = load_library("gang")
+    lib.gang_server_start.restype = ctypes.c_void_p
+    lib.gang_server_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.gang_server_port.argtypes = [ctypes.c_void_p]
+    lib.gang_server_failed.argtypes = [ctypes.c_void_p]
+    lib.gang_server_dead_rank.argtypes = [ctypes.c_void_p]
+    lib.gang_server_registered.argtypes = [ctypes.c_void_p]
+    lib.gang_server_stop.argtypes = [ctypes.c_void_p]
+    lib.gang_client_connect.restype = ctypes.c_void_p
+    lib.gang_client_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.gang_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.gang_client_heartbeat.argtypes = [ctypes.c_void_p]
+    lib.gang_client_world.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.gang_client_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class GangCoordinator:
+    """Driver-side coordinator. world_size hosts must register."""
+
+    def __init__(self, world_size: int, port: int = 0,
+                 heartbeat_timeout_ms: int = 10_000):
+        self._lib = _lib()
+        self._handle = self._lib.gang_server_start(
+            port, world_size, heartbeat_timeout_ms
+        )
+        if not self._handle:
+            raise RuntimeError("gang coordinator failed to start")
+        self.port = self._lib.gang_server_port(self._handle)
+        self.world_size = world_size
+
+    @property
+    def failed(self) -> bool:
+        return bool(self._lib.gang_server_failed(self._handle))
+
+    @property
+    def dead_rank(self) -> int:
+        return int(self._lib.gang_server_dead_rank(self._handle))
+
+    @property
+    def registered(self) -> int:
+        return int(self._lib.gang_server_registered(self._handle))
+
+    def stop(self):
+        if self._handle:
+            self._lib.gang_server_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class GangWorker:
+    """Per-host client: register, barrier, heartbeat, peer table."""
+
+    def __init__(self, host: str, port: int, rank: int, address: str,
+                 timeout_ms: int = 30_000, heartbeat_interval_s: float = 2.0):
+        self._lib = _lib()
+        self.rank = rank
+        self._handle = self._lib.gang_client_connect(
+            host.encode(), port, rank, address.encode(), timeout_ms
+        )
+        if not self._handle:
+            raise GangFailure(f"rank {rank}: cannot register with {host}:{port}")
+        # Separate connection for heartbeats: the main connection can
+        # be parked inside a blocking barrier read, and interleaving
+        # HB traffic on the same socket would steal its GO line.
+        self._hb_handle = self._lib.gang_client_connect(
+            host.encode(), port, rank, address.encode(), timeout_ms
+        )
+        self._hb_lock = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_dead = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_interval_s,), daemon=True
+        )
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval: float):
+        while not self._hb_stop.wait(interval):
+            with self._hb_lock:
+                if self._hb_handle is None:
+                    return
+                rc = self._lib.gang_client_heartbeat(self._hb_handle)
+            if rc != 0:
+                self._hb_dead.set()
+                return
+
+    def barrier(self, epoch: int) -> None:
+        """Gang entry point — the analog of all barrier tasks reaching
+        the stage (``distributed.py:39-43``). Raises on gang failure."""
+        if self._hb_dead.is_set():
+            raise GangFailure("gang member declared dead")
+        rc = self._lib.gang_client_barrier(self._handle, epoch)
+        if rc != 0:
+            raise GangFailure(f"barrier {epoch} failed (rc={rc})")
+
+    def world(self) -> List[str]:
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.gang_client_world(self._handle, buf, len(buf))
+        if n < 0:
+            raise GangFailure("world query failed")
+        return buf.value.decode().split(",") if buf.value else []
+
+    def suspend_heartbeat(self):
+        """Test hook: silence this member so the coordinator's failure
+        detector fires."""
+        self._hb_stop.set()
+
+    def close(self):
+        self._hb_stop.set()
+        with self._hb_lock:
+            if self._hb_handle:
+                self._lib.gang_client_close(self._hb_handle)
+                self._hb_handle = None
+        if self._handle:
+            self._lib.gang_client_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

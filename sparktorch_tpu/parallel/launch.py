@@ -1,0 +1,84 @@
+"""Multi-host bring-up: gang rendezvous -> PJRT distributed init.
+
+The end-to-end analog of the reference's executor bootstrap: Spark
+barrier-schedules one task per executor, each task computes
+MASTER_ADDR from the driver host and joins a gloo group with
+rank=partition_index+1 (``distributed.py:98-110``;
+``torch_distributed.py:305``). Here:
+
+1. host 0 starts the native :class:`GangCoordinator` (C++, TCP);
+2. every host registers (rank, jax-coordinator address), enters
+   barrier 0 — gang semantics: nobody proceeds until the world is
+   complete;
+3. the rank-0 address from the peer table seeds
+   ``jax.distributed.initialize``; libtpu/PJRT then forms the global
+   device set and XLA collectives ride ICI/DCN;
+4. heartbeats keep running — a dead host fails the next barrier fast
+   instead of wedging the pod in a collective.
+
+Single-host (the common dev case) short-circuits all of it.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+import jax
+
+DEFAULT_JAX_COORD_PORT = 8476
+DEFAULT_GANG_PORT = 8475
+
+
+def _local_ip() -> str:
+    # SPARK_LOCAL_IP is honored for drop-in parity with the
+    # reference's address resolution (distributed.py:35-36).
+    env = os.environ.get("SPARK_LOCAL_IP")
+    if env:
+        return env
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def bringup_multihost(
+    rank: int,
+    world_size: int,
+    coordinator_host: Optional[str] = None,
+    gang_port: int = DEFAULT_GANG_PORT,
+    jax_coord_port: int = DEFAULT_JAX_COORD_PORT,
+    heartbeat_timeout_ms: int = 30_000,
+):
+    """Rendezvous the gang and initialize JAX's distributed runtime.
+
+    Returns (coordinator_or_None, worker_or_None); keep the worker
+    alive for the life of training (its heartbeat is the liveness
+    signal) and ``close()`` both on shutdown.
+    """
+    if world_size <= 1:
+        return None, None
+
+    from sparktorch_tpu.native.gang import GangCoordinator, GangWorker
+
+    coord = None
+    if rank == 0:
+        coord = GangCoordinator(world_size=world_size, port=gang_port,
+                                heartbeat_timeout_ms=heartbeat_timeout_ms)
+        gang_port = coord.port
+        coordinator_host = coordinator_host or _local_ip()
+    elif coordinator_host is None:
+        coordinator_host = os.environ.get("SPARKTORCH_TPU_GANG_HOST", "127.0.0.1")
+
+    my_addr = f"{_local_ip()}:{jax_coord_port}"
+    worker = GangWorker(coordinator_host, gang_port, rank, my_addr)
+    worker.barrier(0)  # full gang assembled
+    peers = worker.world()
+
+    jax.distributed.initialize(
+        coordinator_address=peers[0],
+        num_processes=world_size,
+        process_id=rank,
+    )
+    return coord, worker
