@@ -1,0 +1,26 @@
+"""Shared example-data loader.
+
+The reference's examples train on ``examples/mnist_train.csv`` (label
+in column 0, 784 pixel columns). If such a file is present it is
+parsed with the native rowpack reader; otherwise a synthetic
+MNIST-shaped dataset is generated so the examples always run.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def load_mnist(path: str = "examples/mnist_train.csv", n_synthetic: int = 4096):
+    if os.path.exists(path):
+        from sparktorch_tpu.native.rowpack import read_csv
+
+        x, y = read_csv(path, label_col=0)
+        return x / 255.0, y
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.1307, 0.3081, (n_synthetic, 784)).astype(np.float32)
+    w = rng.normal(0, 1, (784, 10)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)  # learnable labels
+    return x, y

@@ -1,0 +1,12 @@
+"""The standalone CNN definition used by the CNN examples.
+
+Counterpart of the reference's ``examples/cnn_network.py:6-24`` (a
+torch ``nn.Module`` with two conv blocks + two dense layers). Here the
+network is the framework's :class:`MnistCNN` Flax module — NHWC
+layout, bf16 compute — importable by lazy serialization exactly like
+the reference imports its ``Net`` class on executors.
+"""
+
+from sparktorch_tpu.models import MnistCNN
+
+__all__ = ["MnistCNN"]

@@ -1,0 +1,48 @@
+"""MNIST CNN with LAZY model shipment.
+
+Counterpart of the reference's ``examples/lazy_load_cnn.py``: the
+model *class* + ctor kwargs are serialized instead of an instance, so
+parameters first materialize on the workers' devices — the driver
+never holds weights (reference README.md:115-132; here strengthened:
+shape recording is abstract via jax.eval_shape).
+"""
+
+import numpy as np
+
+from examples._data import load_mnist
+from examples.cnn_network import MnistCNN
+from sparktorch_tpu import SparkTorch, serialize_torch_obj_lazy
+
+
+def main():
+    x, y = load_mnist()
+    df = {"features": list(x), "label": y}
+
+    torch_obj = serialize_torch_obj_lazy(
+        MnistCNN,
+        criterion="cross_entropy",
+        optimizer="adam",
+        optimizer_params={"lr": 1e-3},
+        model_parameters={"n_classes": 10, "width": 32},
+        input_shape=(784,),
+    )
+
+    stm = SparkTorch(
+        inputCol="features",
+        labelCol="label",
+        predictionCol="predictions",
+        torchObj=torch_obj,
+        iters=40,
+        verbose=1,
+        miniBatch=256,
+    )
+
+    model = stm.fit(df)
+    res = model.transform(df)
+    rows = res.collect()
+    acc = np.mean([float(r["predictions"]) == float(r["label"]) for r in rows])
+    print(f"train accuracy: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""MNIST CNN, hogwild (async parameter-server) training.
+
+Counterpart of the reference's ``examples/simple_cnn.py``, which runs
+``mode='hogwild'`` against the Flask server. Here the parameter server
+holds weights in device HBM with versioned pulls.
+"""
+
+import numpy as np
+
+from examples._data import load_mnist
+from examples.cnn_network import MnistCNN
+from sparktorch_tpu import SparkTorch, serialize_torch_obj
+
+
+def main():
+    x, y = load_mnist()
+    df = {"features": list(x), "label": y}
+
+    torch_obj = serialize_torch_obj(
+        MnistCNN(),
+        criterion="cross_entropy",
+        optimizer="adam",
+        optimizer_params={"lr": 1e-3},
+        input_shape=(784,),
+    )
+
+    stm = SparkTorch(
+        inputCol="features",
+        labelCol="label",
+        predictionCol="predictions",
+        torchObj=torch_obj,
+        iters=30,
+        verbose=1,
+        mode="hogwild",
+        partitions=4,
+        miniBatch=128,
+    )
+
+    model = stm.fit(df)
+    res = model.transform(df)
+    rows = res.collect()
+    acc = np.mean([float(r["predictions"]) == float(r["label"]) for r in rows])
+    print(f"train accuracy: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,7 @@ class TrainResult(NamedTuple):
     model_state: Any
     metrics: list  # list of per-step dicts
     spec: ModelSpec
+    summary: Optional[dict] = None  # roll-up (examples/sec/chip, p50/p99)
 
 
 def _as_batch(data, labels=None, validation_pct=0.0, seed=0):
@@ -94,6 +95,10 @@ def train_distributed(
     device: Optional[str] = None,  # accepted for API parity; mesh decides
     metrics_hook: Optional[Callable[[dict], None]] = None,
     steps_per_call: Optional[int] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+    profile_dir: Optional[str] = None,
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
@@ -121,6 +126,19 @@ def train_distributed(
     # Replicate state across the mesh (reference replicates the model
     # onto every executor, distributed.py:112-115).
     state = jax.device_put(state, replicated(mesh))
+
+    ckpt = None
+    if checkpoint_dir:
+        from sparktorch_tpu.utils.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(checkpoint_dir)
+        if resume and ckpt.latest_step() is not None:
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=a.sharding),
+                state,
+            )
+            state = ckpt.restore(abstract)
 
     loss_fn = spec.loss_fn()
     module = spec.make_module()
@@ -154,8 +172,14 @@ def train_distributed(
         make_eval_step(module.apply, loss_fn, mesh) if val_batch is not None else None
     )
 
-    metrics: list = []
+    from sparktorch_tpu.utils.metrics import MetricsRecorder
+    from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+    recorder = MetricsRecorder(n_chips=mesh.size)
+    metrics = recorder.records
     shuffle_key = jax.random.key(seed + 1)
+    profiler = profile_run(profile_dir)
+    profiler.__enter__()
     for shuffle_round in range(max(1, partition_shuffles)):
         if shuffle_round > 0:
             shuffle_key, sub = jax.random.split(shuffle_key)
@@ -166,7 +190,8 @@ def train_distributed(
             t0 = time.perf_counter()
             if steps_per_call > 1:
                 n = min(steps_per_call, iters - i)
-                state, stacked = train_step(state, train_batch)
+                with step_annotation(int(metrics[-1]["iter"]) + 1 if metrics else 0):
+                    state, stacked = train_step(state, train_batch)
                 losses = np.asarray(stacked.loss)[:n]
                 examples = np.asarray(stacked.examples)[:n]
                 gnorms = np.asarray(stacked.grad_norm)[:n]
@@ -176,7 +201,8 @@ def train_distributed(
                     for l, e, g in zip(losses, examples, gnorms)
                 ]
             else:
-                state, step_metrics = train_step(state, train_batch)
+                with step_annotation(i):
+                    state, step_metrics = train_step(state, train_batch)
                 chunk = [(
                     float(step_metrics.loss),
                     float(step_metrics.examples),
@@ -199,7 +225,7 @@ def train_distributed(
                     "grad_norm": gnorm,
                     "step_time_s": dt,
                 }
-                metrics.append(record)
+                recorder.record(record)
                 if metrics_hook:
                     metrics_hook(record)
                 if verbose:
@@ -219,11 +245,26 @@ def train_distributed(
                         stop = True
                         break
                 i += 1
+            if ckpt is not None and checkpoint_every > 0:
+                step_now = int(jax.device_get(state.step))
+                if step_now % checkpoint_every == 0:
+                    ckpt.save(step_now, state)
             if stop:
                 break
         if stop:
             break
 
+    profiler.__exit__(None, None, None)
+    if ckpt is not None:
+        # Final snapshot at the end of training (unless the periodic
+        # save already captured this exact step).
+        final_step = int(jax.device_get(state.step))
+        if ckpt.latest_step() != final_step:
+            ckpt.save(final_step, state, force=True)
+        ckpt.wait()
+        ckpt.close()
+
     params = jax.device_get(state.params)
     model_state = jax.device_get(state.model_state)
-    return TrainResult(params=params, model_state=model_state, metrics=metrics, spec=spec)
+    return TrainResult(params=params, model_state=model_state, metrics=metrics,
+                       spec=spec, summary=recorder.summary())

@@ -1,0 +1,96 @@
+"""Step-indexed checkpoint / resume (orbax-backed).
+
+The reference has NO mid-training checkpointing (SURVEY §5): the only
+persistence is the final state_dict wrapped into the fitted model
+(``torch_distributed.py:339-348``). This module adds the subsystem at
+the hook point the survey identifies (where the reference returns its
+state_dict, ``distributed.py:206``): step-indexed snapshots of the
+FULL TrainState — params, optimizer state, step counter, rng — with
+retention, atomic finalize, and resume.
+
+Sharded-state aware: orbax restores each leaf directly into the
+sharding of the abstract target, so a resumed fsdp/tp run never
+materializes the full model on one host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from sparktorch_tpu.train.step import TrainState
+
+
+class CheckpointManager:
+    """Thin wrapper over ``ocp.CheckpointManager`` for TrainStates."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state._asdict()), force=force
+        )
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, abstract_state: TrainState,
+                step: Optional[int] = None) -> TrainState:
+        """Restore into the layout described by ``abstract_state``
+        (ShapeDtypeStructs with shardings — use ``jax.eval_shape`` +
+        the trainer's sharding pytree)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self._dir}")
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state._asdict())
+        )
+        return TrainState(**restored)
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def save_model(directory: str, params: Any, model_state: Any = None) -> None:
+    """One-shot final-model save (the reference's only persistence
+    behavior, done properly: a real checkpoint format instead of a
+    dill blob in a string column)."""
+    path = os.path.abspath(directory)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "model"),
+               {"params": params, "model_state": model_state or {}})
+    ckptr.wait_until_finished()
+
+
+def load_model(directory: str, abstract: Optional[Any] = None):
+    path = os.path.abspath(directory)
+    ckptr = ocp.StandardCheckpointer()
+    target = None
+    if abstract is not None:
+        target = {"params": abstract, "model_state": {}}
+    out = ckptr.restore(os.path.join(path, "model"), target)
+    return out["params"], out.get("model_state") or {}

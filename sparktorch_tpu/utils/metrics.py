@@ -1,0 +1,60 @@
+"""Structured per-step training metrics + roll-ups.
+
+The reference's entire observability story is a ``verbose`` int that
+gates raw ``print`` of per-partition losses (``distributed.py:201-204``,
+``hogwild.py:133-134``; SURVEY §5 "Metrics: minimal"). This module is
+the structured replacement, shaped around the BASELINE north-star
+numbers: examples/sec/chip, mean/p50/p99 step time, loss curves.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class MetricsRecorder:
+    def __init__(self, n_chips: int = 1):
+        self.n_chips = max(1, n_chips)
+        self.records: List[Dict[str, Any]] = []
+        self._t_start = time.perf_counter()
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        self.records.append(rec)
+
+    # -- roll-ups (the BASELINE.md protocol numbers) -----------------------
+
+    def summary(self) -> Dict[str, Any]:
+        if not self.records:
+            return {"steps": 0}
+        times = np.asarray([r["step_time_s"] for r in self.records
+                            if r.get("step_time_s")])
+        examples = float(sum(r.get("examples", 0.0) for r in self.records))
+        wall = time.perf_counter() - self._t_start
+        losses = [r["loss"] for r in self.records if r.get("loss") is not None]
+        out = {
+            "steps": len(self.records),
+            "total_examples": examples,
+            "wall_time_s": round(wall, 4),
+            "examples_per_sec": round(examples / wall, 2) if wall > 0 else None,
+            "examples_per_sec_per_chip": round(examples / wall / self.n_chips, 2)
+            if wall > 0 else None,
+            "first_loss": losses[0] if losses else None,
+            "final_loss": losses[-1] if losses else None,
+        }
+        if times.size:
+            out.update(
+                step_time_mean_s=round(float(times.mean()), 6),
+                step_time_p50_s=round(float(np.percentile(times, 50)), 6),
+                step_time_p99_s=round(float(np.percentile(times, 99)), 6),
+            )
+        return out
+
+    def to_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for rec in self.records:
+                f.write(json.dumps(rec) + "\n")
+            f.write(json.dumps({"summary": self.summary()}) + "\n")

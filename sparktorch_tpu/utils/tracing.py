@@ -1,0 +1,35 @@
+"""Profiling/tracing hooks (XLA profiler).
+
+The reference ships no profiler hooks at all (SURVEY §5 "Tracing:
+none"). Here: a trace context for whole runs and per-step annotations
+that show up in the TPU trace viewer, attached at the step loop — the
+hook point the survey names (the equivalent of ``distributed.py:141``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_run(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA profiler trace for the enclosed block when
+    ``log_dir`` is set; no-op otherwise. View with TensorBoard or
+    xprof."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(step: int):
+    """Per-step trace annotation; shows step boundaries in the trace
+    viewer."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
