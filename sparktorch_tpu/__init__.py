@@ -36,6 +36,7 @@ from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
 from sparktorch_tpu.ml.estimator import SparkTorch, SparkTorchModel
 from sparktorch_tpu.ml.pipeline import Pipeline, PipelineModel, PysparkPipelineWrapper
 from sparktorch_tpu.inference import (
+    BatchPredictor,
     create_spark_torch_model,
     attach_model_to_pipeline,
     attach_pytorch_model_to_pipeline,
@@ -61,6 +62,7 @@ __all__ = [
     "Pipeline",
     "PipelineModel",
     "PysparkPipelineWrapper",
+    "BatchPredictor",
     "create_spark_torch_model",
     "attach_model_to_pipeline",
     "attach_pytorch_model_to_pipeline",

@@ -87,6 +87,15 @@ class SparkTorchModel(Model):
         self._set(**self._input_kwargs)
         self._bundle_cache = None
         self._forward_cache = None
+        self._mesh = None
+
+    def setMesh(self, mesh):
+        """Mesh-parallel inference: the prediction batch dim is
+        sharded over the mesh's dp axes so every chip serves a slice
+        (the 1M-row batch-inference path, BASELINE config 5)."""
+        self._mesh = mesh
+        self._forward_cache = None
+        return self
 
     def getModStr(self) -> str:
         return self.getOrDefault(self.modStr)
@@ -111,29 +120,22 @@ class SparkTorchModel(Model):
 
     # -- inference ---------------------------------------------------------
 
-    def _forward(self):
+    def _predictor(self):
         if self._forward_cache is None:
             bundle = self.getModel()
-            from sparktorch_tpu.train.step import make_forward_fn
+            from sparktorch_tpu.inference import BatchPredictor
 
-            self._forward_cache = (bundle, make_forward_fn(bundle.module.apply))
+            self._forward_cache = BatchPredictor(
+                bundle.module, bundle.params, bundle.model_state,
+                mesh=self._mesh, chunk=_INFER_CHUNK,
+            )
         return self._forward_cache
 
     def _predict_matrix(self, x: np.ndarray) -> np.ndarray:
         """Chunked, padded, compiled batch inference — replaces the
-        per-row UDF hot loop (``torch_distributed.py:112-120``)."""
-        bundle, fwd = self._forward()
-        n = x.shape[0]
-        outs = []
-        for start in range(0, n, _INFER_CHUNK):
-            chunk = x[start : start + _INFER_CHUNK]
-            real = chunk.shape[0]
-            if real < _INFER_CHUNK and n > _INFER_CHUNK:
-                pad = np.zeros((_INFER_CHUNK - real, *chunk.shape[1:]), chunk.dtype)
-                chunk = np.concatenate([chunk, pad])
-            out = np.asarray(fwd(bundle.params, bundle.model_state, jnp.asarray(chunk)))
-            outs.append(out[:real])
-        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+        per-row UDF hot loop (``torch_distributed.py:112-120``);
+        mesh-parallel when ``setMesh`` was called."""
+        return self._predictor().predict(x)
 
     def _transform(self, dataset):
         df = LocalDataFrame.from_any(dataset)

@@ -1,0 +1,322 @@
+"""PySpark Estimator/Model adapter over the TPU trainers.
+
+The deployment-facing analog of the reference's
+``sparktorch/torch_distributed.py``: a real ``pyspark.ml`` Estimator
+with the same Param surface, fitting models on TPU hardware. Two
+deploy modes:
+
+- ``deployMode='driver'`` (default): executors only *produce data*
+  (their partitions stream to the driver), the driver runs the SPMD
+  trainer over its attached TPU slice. This inverts the reference's
+  topology (training on executors) because on TPU pods the
+  accelerator set is attached to dedicated hosts, not to Spark
+  executors; it removes the reference's phantom-rank and
+  hardcoded-port machinery outright.
+- ``deployMode='barrier'``: the reference's topology, TPU-native —
+  one Spark **barrier task per TPU host** (``rdd.barrier()``; the
+  reference builds a barrier RDD at ``distributed.py:39-43``). Task
+  index = process rank; the driver runs the native C++ gang
+  coordinator; each task calls
+  :func:`sparktorch_tpu.parallel.launch.bringup_multihost`, which
+  rendezvouses and runs ``jax.distributed.initialize`` so the pod
+  forms one global mesh; every host feeds its partition into the
+  shared SPMD step (weight-0 padding absorbs skew — no phantom
+  ranks). Requires executors co-located with the TPU hosts.
+
+Inference (`SparkTorchModel._transform`) is an Arrow-batched pandas
+UDF over a broadcast model bundle running the compiled chunked
+forward — versus the reference's batch-1 row UDF
+(``torch_distributed.py:106-120``).
+
+This module imports pyspark at import time and is exercised only in
+Spark deployments (pyspark is not in this repo's test image).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+try:
+    from pyspark import keyword_only
+    from pyspark.ml.base import Estimator, Model
+    from pyspark.ml.param import Param, Params, TypeConverters
+    from pyspark.ml.param.shared import HasInputCol, HasLabelCol, HasPredictionCol
+    from pyspark.sql.functions import pandas_udf
+    from pyspark.sql.types import ArrayType, DoubleType
+except ImportError as _e:  # pragma: no cover
+    raise ImportError(
+        "sparktorch_tpu.spark requires pyspark; use sparktorch_tpu.ml for "
+        "the JVM-free surface"
+    ) from _e
+
+import dill
+
+from sparktorch_tpu.ml.estimator import _decode_bundle, _encode_bundle
+from sparktorch_tpu.utils.serde import deserialize_model
+
+
+class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
+    """The reference's 14 declared Params (torch_distributed.py:141-154)
+    plus deployMode."""
+
+    torchObj = Param(Params._dummy(), "torchObj", "serialized model spec",
+                     typeConverter=TypeConverters.toString)
+    mode = Param(Params._dummy(), "mode", "synchronous | hogwild",
+                 typeConverter=TypeConverters.toString)
+    device = Param(Params._dummy(), "device", "parity no-op (mesh decides)",
+                   typeConverter=TypeConverters.toString)
+    iters = Param(Params._dummy(), "iters", "", typeConverter=TypeConverters.toInt)
+    partitions = Param(Params._dummy(), "partitions", "",
+                       typeConverter=TypeConverters.toInt)
+    verbose = Param(Params._dummy(), "verbose", "", typeConverter=TypeConverters.toInt)
+    acquireLock = Param(Params._dummy(), "acquireLock", "",
+                        typeConverter=TypeConverters.toBoolean)
+    partitionShuffles = Param(Params._dummy(), "partitionShuffles", "",
+                              typeConverter=TypeConverters.toInt)
+    port = Param(Params._dummy(), "port", "", typeConverter=TypeConverters.toInt)
+    useBarrier = Param(Params._dummy(), "useBarrier", "",
+                       typeConverter=TypeConverters.toBoolean)
+    useVectorOut = Param(Params._dummy(), "useVectorOut", "",
+                         typeConverter=TypeConverters.toBoolean)
+    earlyStopPatience = Param(Params._dummy(), "earlyStopPatience", "",
+                              typeConverter=TypeConverters.toInt)
+    miniBatch = Param(Params._dummy(), "miniBatch", "",
+                      typeConverter=TypeConverters.toInt)
+    validationPct = Param(Params._dummy(), "validationPct", "",
+                          typeConverter=TypeConverters.toFloat)
+    deployMode = Param(Params._dummy(), "deployMode", "driver | barrier",
+                       typeConverter=TypeConverters.toString)
+
+
+class SparkTorch(Estimator, _SparkTorchParams):
+    @keyword_only
+    def __init__(self, inputCol=None, labelCol=None, predictionCol=None,
+                 torchObj=None, iters=None, partitions=None, verbose=None,
+                 mode=None, device=None, acquireLock=None,
+                 partitionShuffles=None, port=None, useBarrier=None,
+                 useVectorOut=None, earlyStopPatience=None, miniBatch=None,
+                 validationPct=None, deployMode=None):
+        super().__init__()
+        self._setDefault(
+            predictionCol="predictions", mode="synchronous", device="tpu",
+            iters=10, verbose=0, acquireLock=True, partitionShuffles=1,
+            port=3000, useBarrier=True, useVectorOut=False,
+            earlyStopPatience=-1, miniBatch=-1, validationPct=0.0,
+            deployMode="driver",
+        )
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, **kwargs):
+        return self._set(**self._input_kwargs)
+
+    # -- data movement -----------------------------------------------------
+
+    def _collect_xy(self, dataset):
+        """Executors -> driver column stream (deployMode='driver')."""
+        inp = self.getOrDefault(self.inputCol)
+        label = (self.getOrDefault(self.labelCol)
+                 if self.isDefined(self.labelCol) else None)
+        cols = [inp] + ([label] if label else [])
+        rows = dataset.select(*cols).collect()
+        x = np.stack([np.asarray(r[0], dtype=np.float32)
+                      if not hasattr(r[0], "toArray")
+                      else r[0].toArray().astype(np.float32) for r in rows])
+        y = np.asarray([r[1] for r in rows], dtype=np.float32) if label else None
+        return x, y
+
+    # -- fit ---------------------------------------------------------------
+
+    def _fit(self, dataset):
+        if self.getOrDefault(self.deployMode) == "barrier":
+            result = self._fit_barrier(dataset)
+        else:
+            result = self._fit_driver(dataset)
+        return SparkTorchModel(
+            inputCol=self.getOrDefault(self.inputCol),
+            predictionCol=self.getOrDefault(self.predictionCol),
+            modStr=result,
+            useVectorOut=self.getOrDefault(self.useVectorOut),
+        )
+
+    def _fit_driver(self, dataset) -> str:
+        x, y = self._collect_xy(dataset)
+        spec = deserialize_model(self.getOrDefault(self.torchObj))
+        mini_batch = self.getOrDefault(self.miniBatch)
+        mini_batch = None if mini_batch <= 0 else mini_batch
+        mode = self.getOrDefault(self.mode)
+        if mode in ("hogwild", "async"):
+            from sparktorch_tpu.train.hogwild import train_async
+
+            result = train_async(
+                spec, x, labels=y,
+                iters=self.getOrDefault(self.iters),
+                partition_shuffles=self.getOrDefault(self.partitionShuffles),
+                verbose=self.getOrDefault(self.verbose),
+                mini_batch=mini_batch,
+                validation_pct=self.getOrDefault(self.validationPct),
+                early_stop_patience=self.getOrDefault(self.earlyStopPatience),
+                acquire_lock=self.getOrDefault(self.acquireLock),
+                port=self.getOrDefault(self.port),
+                partitions=self.getOrDefault(self.partitions)
+                if self.isDefined(self.partitions) else -1,
+            )
+        else:
+            from sparktorch_tpu.train.sync import train_distributed
+
+            result = train_distributed(
+                spec, x, labels=y,
+                iters=self.getOrDefault(self.iters),
+                partition_shuffles=self.getOrDefault(self.partitionShuffles),
+                verbose=self.getOrDefault(self.verbose),
+                mini_batch=mini_batch,
+                validation_pct=self.getOrDefault(self.validationPct),
+                early_stop_patience=self.getOrDefault(self.earlyStopPatience),
+            )
+        return _encode_bundle(result.spec, result.params, result.model_state)
+
+    def _fit_barrier(self, dataset) -> str:
+        """One barrier task per TPU host; rank = barrier partition id."""
+        inp = self.getOrDefault(self.inputCol)
+        label = (self.getOrDefault(self.labelCol)
+                 if self.isDefined(self.labelCol) else None)
+        torch_obj = self.getOrDefault(self.torchObj)
+        iters = self.getOrDefault(self.iters)
+        mini_batch = self.getOrDefault(self.miniBatch)
+        mini_batch = None if mini_batch <= 0 else mini_batch
+        shuffles = self.getOrDefault(self.partitionShuffles)
+        verbose = self.getOrDefault(self.verbose)
+        val_pct = self.getOrDefault(self.validationPct)
+        patience = self.getOrDefault(self.earlyStopPatience)
+        gang_host = dataset.sql_ctx.sparkSession.conf.get(
+            "spark.driver.host", "127.0.0.1"
+        )
+        n_hosts = (self.getOrDefault(self.partitions)
+                   if self.isDefined(self.partitions)
+                   else dataset.rdd.getNumPartitions())
+        rdd = dataset.select(
+            *( [inp] + ([label] if label else []) )
+        ).rdd
+        if rdd.getNumPartitions() != n_hosts:
+            rdd = rdd.repartition(n_hosts)
+
+        # Driver side: start the native gang coordinator before
+        # launching the barrier stage.
+        from sparktorch_tpu.native.gang import GangCoordinator
+        from sparktorch_tpu.parallel.launch import DEFAULT_GANG_PORT
+
+        coord = GangCoordinator(world_size=n_hosts, port=DEFAULT_GANG_PORT)
+        gang_port = coord.port
+
+        def run_host(iterator):
+            from pyspark import BarrierTaskContext
+
+            ctx = BarrierTaskContext.get()
+            rank = ctx.partitionId()
+            rows = list(iterator)
+            x = np.stack([
+                np.asarray(r[0], dtype=np.float32)
+                if not hasattr(r[0], "toArray")
+                else r[0].toArray().astype(np.float32)
+                for r in rows
+            ]) if rows else np.zeros((0, 1), np.float32)
+            y = (np.asarray([r[1] for r in rows], dtype=np.float32)
+                 if rows and label else None)
+
+            from sparktorch_tpu.parallel.launch import bringup_multihost
+            from sparktorch_tpu.train.sync import train_distributed
+
+            _, worker = bringup_multihost(
+                rank=rank, world_size=n_hosts, coordinator_host=gang_host,
+                gang_port=gang_port,
+            )
+            try:
+                # Global mesh over the whole pod; every host feeds its
+                # partition. Skewed/empty partitions are weight-0
+                # padding inside the global batch.
+                result = train_distributed(
+                    torch_obj, x, labels=y, iters=iters,
+                    partition_shuffles=shuffles, verbose=verbose,
+                    mini_batch=mini_batch, validation_pct=val_pct,
+                    early_stop_patience=patience,
+                )
+                # Rank 0's view of the replicated result is canonical
+                # (the reference keeps collect()[0],
+                # distributed.py:267-273).
+                if rank == 0:
+                    payload = _encode_bundle(
+                        result.spec, result.params, result.model_state
+                    )
+                    yield base64.b64encode(dill.dumps(payload)).decode()
+            finally:
+                if worker is not None:
+                    worker.close()
+
+        try:
+            out = rdd.barrier().mapPartitions(run_host).collect()
+        finally:
+            coord.stop()
+        if not out:
+            raise RuntimeError("barrier training returned no model")
+        return dill.loads(base64.b64decode(out[0]))
+
+
+class SparkTorchModel(Model, _SparkTorchParams):
+    modStr = Param(Params._dummy(), "modStr", "serialized trained model",
+                   typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, inputCol=None, predictionCol=None, modStr=None,
+                 useVectorOut=None):
+        super().__init__()
+        self._setDefault(predictionCol="predictions", useVectorOut=False)
+        self._set(**self._input_kwargs)
+
+    def getPytorchModel(self):
+        """Decoded {spec, params, model_state} bundle
+        (torch_distributed.py:92-94 parity)."""
+        return _decode_bundle(self.getOrDefault(self.modStr))
+
+    def _transform(self, dataset):
+        inp = self.getOrDefault(self.inputCol)
+        out_col = self.getOrDefault(self.predictionCol)
+        use_vec = self.getOrDefault(self.useVectorOut)
+        mod_str = self.getOrDefault(self.modStr)
+        sc = dataset.sql_ctx.sparkSession.sparkContext
+        broadcast_mod = sc.broadcast(mod_str)
+
+        def make_predictor():
+            from sparktorch_tpu.inference import BatchPredictor
+
+            payload = _decode_bundle(broadcast_mod.value)
+            spec = payload["spec"]
+            return BatchPredictor(spec.make_module(), payload["params"],
+                                  payload["model_state"])
+
+        if use_vec:
+            @pandas_udf(ArrayType(DoubleType()))
+            def predict(series):
+                import pandas as pd
+
+                predictor = make_predictor()
+                x = np.stack([np.asarray(v, dtype=np.float32) for v in series])
+                out = predictor.predict(x)
+                return pd.Series([row.astype(float).tolist() for row in out])
+        else:
+            @pandas_udf(DoubleType())
+            def predict(series):
+                import pandas as pd
+
+                predictor = make_predictor()
+                x = np.stack([np.asarray(v, dtype=np.float32) for v in series])
+                out = predictor.predict(x)
+                flat = out.reshape(out.shape[0], -1)
+                vals = (np.argmax(flat, axis=1).astype(np.float64)
+                        if flat.shape[1] > 1 else flat[:, 0].astype(np.float64))
+                return pd.Series(vals)
+
+        return dataset.withColumn(out_col, predict(dataset[inp]))
