@@ -74,6 +74,14 @@ class BatchPredictor:
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         n = x.shape[0]
+        if n == 0:
+            # Probe one padded shard-batch for the output shape.
+            probe = np.zeros((self._n_shards, *x.shape[1:]), x.dtype)
+            arr = jnp.asarray(probe)
+            if self._x_sharding is not None:
+                arr = jax.device_put(arr, self._x_sharding)
+            out = np.asarray(self._fwd(self._params, self._model_state, arr))
+            return out[:0]
         outs = []
         ns = self._n_shards
         for start in range(0, n, self.chunk):

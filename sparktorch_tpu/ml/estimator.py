@@ -24,8 +24,6 @@ import base64
 from typing import Any, NamedTuple, Optional
 
 import dill
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from sparktorch_tpu.ml.dataset import LocalDataFrame

@@ -50,20 +50,28 @@ def bringup_multihost(
     gang_port: int = DEFAULT_GANG_PORT,
     jax_coord_port: int = DEFAULT_JAX_COORD_PORT,
     heartbeat_timeout_ms: int = 30_000,
+    start_coordinator: Optional[bool] = None,
 ):
     """Rendezvous the gang and initialize JAX's distributed runtime.
 
     Returns (coordinator_or_None, worker_or_None); keep the worker
     alive for the life of training (its heartbeat is the liveness
     signal) and ``close()`` both on shutdown.
+
+    ``start_coordinator``: by default rank 0 hosts the coordinator.
+    Pass False when an external process (e.g. the Spark driver in the
+    pyspark adapter's barrier mode) already runs one — otherwise rank
+    0 would try to bind the same port a second time.
     """
     if world_size <= 1:
         return None, None
 
     from sparktorch_tpu.native.gang import GangCoordinator, GangWorker
 
+    if start_coordinator is None:
+        start_coordinator = rank == 0
     coord = None
-    if rank == 0:
+    if start_coordinator:
         coord = GangCoordinator(world_size=world_size, port=gang_port,
                                 heartbeat_timeout_ms=heartbeat_timeout_ms)
         gang_port = coord.port

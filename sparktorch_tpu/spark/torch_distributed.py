@@ -34,8 +34,6 @@ Spark deployments (pyspark is not in this repo's test image).
 
 from __future__ import annotations
 
-import base64
-import os
 from typing import Any, Optional
 
 import numpy as np
@@ -53,7 +51,6 @@ except ImportError as _e:  # pragma: no cover
         "the JVM-free surface"
     ) from _e
 
-import dill
 
 from sparktorch_tpu.ml.estimator import _decode_bundle, _encode_bundle
 from sparktorch_tpu.utils.serde import deserialize_model
@@ -180,7 +177,22 @@ class SparkTorch(Estimator, _SparkTorchParams):
         return _encode_bundle(result.spec, result.params, result.model_state)
 
     def _fit_barrier(self, dataset) -> str:
-        """One barrier task per TPU host; rank = barrier partition id."""
+        """One barrier task per TPU host; rank = barrier partition id.
+
+        Each task joins the gang (coordinator runs on the DRIVER),
+        initializes the pod-wide PJRT runtime, and contributes its
+        partition to the GLOBAL batch via
+        ``train_distributed_multihost`` (which allgathers row counts,
+        pads skewed/empty partitions with weight-0 rows, and builds
+        the globally-sharded arrays with
+        ``jax.make_array_from_process_local_data``).
+        """
+        if self.getOrDefault(self.mode) in ("hogwild", "async"):
+            raise ValueError(
+                "deployMode='barrier' supports mode='synchronous' only; "
+                "run hogwild with deployMode='driver' (the parameter "
+                "server lives on the driver either way)"
+            )
         inp = self.getOrDefault(self.inputCol)
         label = (self.getOrDefault(self.labelCol)
                  if self.isDefined(self.labelCol) else None)
@@ -190,22 +202,18 @@ class SparkTorch(Estimator, _SparkTorchParams):
         mini_batch = None if mini_batch <= 0 else mini_batch
         shuffles = self.getOrDefault(self.partitionShuffles)
         verbose = self.getOrDefault(self.verbose)
-        val_pct = self.getOrDefault(self.validationPct)
         patience = self.getOrDefault(self.earlyStopPatience)
-        gang_host = dataset.sql_ctx.sparkSession.conf.get(
-            "spark.driver.host", "127.0.0.1"
-        )
+        spark = dataset.sparkSession
+        gang_host = spark.conf.get("spark.driver.host", "127.0.0.1")
         n_hosts = (self.getOrDefault(self.partitions)
                    if self.isDefined(self.partitions)
                    else dataset.rdd.getNumPartitions())
-        rdd = dataset.select(
-            *( [inp] + ([label] if label else []) )
-        ).rdd
+        rdd = dataset.select(*([inp] + ([label] if label else []))).rdd
         if rdd.getNumPartitions() != n_hosts:
             rdd = rdd.repartition(n_hosts)
 
-        # Driver side: start the native gang coordinator before
-        # launching the barrier stage.
+        # The coordinator runs HERE on the driver; barrier tasks must
+        # not start their own (start_coordinator=False below).
         from sparktorch_tpu.native.gang import GangCoordinator
         from sparktorch_tpu.parallel.launch import DEFAULT_GANG_PORT
 
@@ -228,30 +236,25 @@ class SparkTorch(Estimator, _SparkTorchParams):
                  if rows and label else None)
 
             from sparktorch_tpu.parallel.launch import bringup_multihost
-            from sparktorch_tpu.train.sync import train_distributed
+            from sparktorch_tpu.train.sync import train_distributed_multihost
 
             _, worker = bringup_multihost(
                 rank=rank, world_size=n_hosts, coordinator_host=gang_host,
-                gang_port=gang_port,
+                gang_port=gang_port, start_coordinator=False,
             )
             try:
-                # Global mesh over the whole pod; every host feeds its
-                # partition. Skewed/empty partitions are weight-0
-                # padding inside the global batch.
-                result = train_distributed(
-                    torch_obj, x, labels=y, iters=iters,
+                result = train_distributed_multihost(
+                    torch_obj, x, local_y=y, iters=iters,
                     partition_shuffles=shuffles, verbose=verbose,
-                    mini_batch=mini_batch, validation_pct=val_pct,
-                    early_stop_patience=patience,
+                    mini_batch=mini_batch, early_stop_patience=patience,
                 )
-                # Rank 0's view of the replicated result is canonical
-                # (the reference keeps collect()[0],
+                # The SPMD result is replicated; rank 0's copy is
+                # canonical (the reference keeps collect()[0],
                 # distributed.py:267-273).
                 if rank == 0:
-                    payload = _encode_bundle(
+                    yield _encode_bundle(
                         result.spec, result.params, result.model_state
                     )
-                    yield base64.b64encode(dill.dumps(payload)).decode()
             finally:
                 if worker is not None:
                     worker.close()
@@ -262,7 +265,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
             coord.stop()
         if not out:
             raise RuntimeError("barrier training returned no model")
-        return dill.loads(base64.b64decode(out[0]))
+        return out[0]
 
 
 class SparkTorchModel(Model, _SparkTorchParams):
@@ -286,8 +289,20 @@ class SparkTorchModel(Model, _SparkTorchParams):
         out_col = self.getOrDefault(self.predictionCol)
         use_vec = self.getOrDefault(self.useVectorOut)
         mod_str = self.getOrDefault(self.modStr)
-        sc = dataset.sql_ctx.sparkSession.sparkContext
+        sc = dataset.sparkSession.sparkContext
         broadcast_mod = sc.broadcast(mod_str)
+
+        # Arrow cannot serialize VectorUDT columns into a pandas_udf;
+        # convert Spark ML vectors to plain arrays first.
+        input_col = dataset[inp]
+        try:
+            from pyspark.ml.linalg import VectorUDT
+            from pyspark.ml.functions import vector_to_array
+
+            if isinstance(dataset.schema[inp].dataType, VectorUDT):
+                input_col = vector_to_array(input_col)
+        except ImportError:
+            pass
 
         def make_predictor():
             from sparktorch_tpu.inference import BatchPredictor
@@ -319,4 +334,4 @@ class SparkTorchModel(Model, _SparkTorchParams):
                         if flat.shape[1] > 1 else flat[:, 0].astype(np.float64))
                 return pd.Series(vals)
 
-        return dataset.withColumn(out_col, predict(dataset[inp]))
+        return dataset.withColumn(out_col, predict(input_col))

@@ -301,16 +301,3 @@ def make_eval_step(
         check_vma=False,
     )
     return jax.jit(mapped)
-
-
-def make_forward_fn(apply_fn: Callable) -> Callable:
-    """Jitted batched inference forward (used by the Transformer side;
-    fixes the reference's batch-1-per-row UDF pathology,
-    ``torch_distributed.py:106``)."""
-
-    @jax.jit
-    def forward(params, model_state, x):
-        variables = {"params": params, **(model_state or {})}
-        return apply_fn(variables, x)
-
-    return forward

@@ -99,6 +99,7 @@ def train_distributed(
     checkpoint_every: int = 0,
     resume: bool = False,
     profile_dir: Optional[str] = None,
+    pre_sharded: bool = False,
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
@@ -111,18 +112,32 @@ def train_distributed(
     spec = deserialize_model(torch_obj)
     mesh = mesh or build_mesh()
 
-    train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
-    if spec.input_shape is None:
-        spec.input_shape = tuple(np.asarray(train_batch.x).shape[1:])
+    if pre_sharded:
+        # ``data`` is already a globally-sharded DataBatch (multi-host
+        # path, train_distributed_multihost) — do not re-place it.
+        train_batch, val_batch = data, None
+        if spec.input_shape is None:
+            spec.input_shape = tuple(train_batch.x.shape[1:])
+    else:
+        train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
+        if spec.input_shape is None:
+            spec.input_shape = tuple(np.asarray(train_batch.x).shape[1:])
 
-    train_batch = prepare_sharded_batch(train_batch, mesh)
-    if val_batch is not None:
-        val_batch = prepare_sharded_batch(val_batch, mesh)
+        train_batch = prepare_sharded_batch(train_batch, mesh)
+        if val_batch is not None:
+            val_batch = prepare_sharded_batch(val_batch, mesh)
 
     rng = jax.random.key(seed)
     tx = spec.make_optimizer()
+    if pre_sharded:
+        # Slicing a non-fully-addressable global array is not allowed;
+        # init from an abstract sample of the right shape instead.
+        sample_x = jnp.zeros((1,) + tuple(train_batch.x.shape[1:]),
+                             train_batch.x.dtype)
+    else:
+        sample_x = train_batch.x[:1]
     with mesh:
-        state = create_train_state(spec, rng, sample_x=train_batch.x[:1], tx=tx)
+        state = create_train_state(spec, rng, sample_x=sample_x, tx=tx)
     # Replicate state across the mesh (reference replicates the model
     # onto every executor, distributed.py:112-115).
     state = jax.device_put(state, replicated(mesh))
@@ -268,3 +283,66 @@ def train_distributed(
     model_state = jax.device_get(state.model_state)
     return TrainResult(params=params, model_state=model_state, metrics=metrics,
                        spec=spec, summary=recorder.summary())
+
+
+def train_distributed_multihost(
+    torch_obj: Union[str, ModelSpec],
+    local_x: np.ndarray,
+    local_y: Optional[np.ndarray] = None,
+    mesh: Optional[Mesh] = None,
+    **kwargs,
+) -> TrainResult:
+    """Multi-host entry: each process contributes ITS partition of the
+    data; the global batch is assembled across processes.
+
+    Call after ``jax.distributed.initialize`` (e.g. via
+    ``parallel.launch.bringup_multihost``). The analog of the
+    reference's executor-side ``handle_model`` receiving a partition
+    iterator (``distributed.py:66-128``), minus the phantom ranks:
+    hosts with fewer rows pad with weight-0 examples, so skewed and
+    empty partitions are mathematically absorbed into the global
+    weighted mean.
+    """
+    from jax.experimental import multihost_utils
+
+    mesh = mesh or build_mesh()
+    n_proc = jax.process_count()
+
+    local_x = np.asarray(local_x, dtype=np.float32)
+    if local_x.ndim == 1:
+        local_x = local_x.reshape(0, 1) if local_x.size == 0 else local_x[:, None]
+    if local_y is None:
+        local_y = local_x
+    local_y = np.asarray(local_y)
+    local_w = np.ones((local_x.shape[0],), np.float32)
+
+    # Agree on a common per-host row count (hosts must build
+    # identically-shaped local shards for the global array).
+    counts = multihost_utils.process_allgather(
+        np.asarray([local_x.shape[0]], np.int64)
+    ).reshape(-1)
+    per_host = int(counts.max())
+    # The global batch must divide the mesh's batch shards.
+    n_shards = 1
+    for ax in BATCH_AXES:
+        n_shards *= mesh.shape[ax]
+    shards_per_host = max(1, n_shards // n_proc)
+    per_host = max(
+        shards_per_host,
+        -(-per_host // shards_per_host) * shards_per_host,
+    )
+
+    def pad_to(arr, n):
+        if arr.shape[0] == n:
+            return arr
+        widths = [(0, n - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, widths)
+
+    sharding = batch_sharding(mesh)
+    global_batch = DataBatch(
+        jax.make_array_from_process_local_data(sharding, pad_to(local_x, per_host)),
+        jax.make_array_from_process_local_data(sharding, pad_to(local_y, per_host)),
+        jax.make_array_from_process_local_data(sharding, pad_to(local_w, per_host)),
+    )
+    return train_distributed(torch_obj, global_batch, mesh=mesh,
+                             pre_sharded=True, **kwargs)

@@ -106,3 +106,18 @@ def test_single_vs_multi_device_parity():
     np.testing.assert_allclose(l1, l8, rtol=2e-4)
     for a, b in zip(jax.tree.leaves(r1.params), jax.tree.leaves(r8.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+
+
+def test_multihost_entry_single_process():
+    """train_distributed_multihost in a 1-process world still builds
+    the global batch via make_array_from_process_local_data and runs
+    the pre-sharded path (the barrier deploy mode's data feeding)."""
+    from sparktorch_tpu.train.sync import train_distributed_multihost
+
+    x, y = _blob_data(n=102)
+    x, y = x[:101], y[:101]  # ragged: padding to shard divisibility
+    payload = serialize_model(Net(), "mse", "adam", {"lr": 1e-2}, input_shape=(10,))
+    result = train_distributed_multihost(payload, x, local_y=y, iters=10)
+    losses = [m["loss"] for m in result.metrics]
+    assert losses[-1] < losses[0]
+    assert result.metrics[0]["examples"] == 101.0
