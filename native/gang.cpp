@@ -22,9 +22,12 @@
 //   HB <rank>\n           -> OK\n | DEAD\n
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
+
+#include <set>
 
 #include <atomic>
 #include <chrono>
@@ -60,6 +63,7 @@ struct GangServer {
   std::thread accept_thread;
   std::thread monitor_thread;
   std::vector<std::thread> conn_threads;
+  std::set<int> conn_fds;  // live accepted sockets, for prompt shutdown
   std::mutex conn_mu;
 };
 
@@ -113,8 +117,14 @@ void handle_conn(GangServer *srv, int fd) {
         return st.barrier_count[epoch] >= st.world_size ||
                st.failed.load() || !st.running.load();
       });
+      // GO only for a genuinely complete barrier: a waiter released by
+      // failure OR coordinator shutdown must see an error, never a
+      // spurious green light into a collective that will hang.
+      bool complete = st.barrier_count[epoch] >= st.world_size;
       lock.unlock();
-      write_all(fd, st.failed.load() ? "DEAD\n" : "GO\n");
+      write_all(fd, (complete && !st.failed.load() && st.running.load())
+                        ? "GO\n"
+                        : "DEAD\n");
     } else if (line.rfind("HB ", 0) == 0) {
       int rank = atoi(line.c_str() + 3);
       {
@@ -135,6 +145,10 @@ void handle_conn(GangServer *srv, int fd) {
     } else {
       write_all(fd, "ERR unknown\n");
     }
+  }
+  {
+    std::lock_guard<std::mutex> lock(srv->conn_mu);
+    srv->conn_fds.erase(fd);
   }
   close(fd);
 }
@@ -171,6 +185,7 @@ void accept_loop(GangServer *srv) {
       continue;
     }
     std::lock_guard<std::mutex> lock(srv->conn_mu);
+    srv->conn_fds.insert(fd);
     srv->conn_threads.emplace_back(handle_conn, srv, fd);
   }
 }
@@ -181,24 +196,30 @@ struct GangClient {
 };
 
 int dial(const char *host, int port, int timeout_ms) {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return -1;
-  struct timeval tv;
-  tv.tv_sec = timeout_ms / 1000;
-  tv.tv_usec = (timeout_ms % 1000) * 1000;
-  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-  sockaddr_in sa{};
-  sa.sin_family = AF_INET;
-  sa.sin_port = htons(static_cast<uint16_t>(port));
-  if (inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
-    close(fd);
+  // Resolve with getaddrinfo: in real deployments the coordinator host
+  // arrives as a hostname/FQDN (e.g. Spark's spark.driver.host), not an
+  // IPv4 literal. getaddrinfo handles both.
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo *res = nullptr;
+  std::string port_str = std::to_string(port);
+  if (getaddrinfo(host, port_str.c_str(), &hints, &res) != 0 || !res)
     return -1;
-  }
-  if (connect(fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) != 0) {
+  int fd = -1;
+  for (struct addrinfo *ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv;
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
     close(fd);
-    return -1;
+    fd = -1;
   }
+  freeaddrinfo(res);
   return fd;
 }
 
@@ -253,17 +274,27 @@ int gang_server_registered(void *p) {
 
 void gang_server_stop(void *p) {
   auto *srv = static_cast<GangServer *>(p);
-  srv->state.running.store(false);
-  srv->state.cv.notify_all();
+  {
+    // Store+notify under the monitor mutex: without it a BAR handler
+    // can evaluate its wait predicate just before the store and then
+    // block after the notify — a lost wakeup that wedges stop().
+    std::lock_guard<std::mutex> lock(srv->state.mu);
+    srv->state.running.store(false);
+    srv->state.cv.notify_all();
+  }
   shutdown(srv->listen_fd, SHUT_RDWR);
   close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
   if (srv->monitor_thread.joinable()) srv->monitor_thread.join();
+  // Unblock handler threads parked in recv() on live client sockets —
+  // a worker that died without closing its socket (the very failure the
+  // coordinator detects) must not wedge stop() in join().
   {
     std::lock_guard<std::mutex> lock(srv->conn_mu);
-    for (auto &t : srv->conn_threads)
-      if (t.joinable()) t.join();
+    for (int fd : srv->conn_fds) shutdown(fd, SHUT_RDWR);
   }
+  for (auto &t : srv->conn_threads)
+    if (t.joinable()) t.join();
   delete srv;
 }
 

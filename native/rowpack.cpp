@@ -8,6 +8,13 @@
 // one worker thread per chunk, straight into a caller-allocated
 // float32 buffer. Label column extraction is fused into the same scan.
 //
+// A "data row" is a line containing at least one character that is
+// neither '\r' nor '\n'. Counting (scan_dims), chunk row numbering
+// (rows_before) and parsing (parse_chunk) all share that definition,
+// so blank lines anywhere in the file cannot skew row indices against
+// the caller-allocated buffers; parse_chunk additionally bound-checks
+// every row write.
+//
 // C API (ctypes):
 //   rowpack_count(path, *rows, *cols)          -> 0 ok
 //   rowpack_parse(path, out, rows, cols,
@@ -24,80 +31,119 @@
 namespace {
 
 // Count data rows and columns of a CSV (header detected by presence
-// of a non-numeric first field).
+// of a non-numeric first character). Streams bytes so lines longer
+// than the read buffer are still counted once.
 int scan_dims(const char *path, long *rows, int *cols, long *data_start) {
   FILE *f = fopen(path, "rb");
   if (!f) return -1;
-  std::string line;
   char buf[1 << 16];
   long r = 0;
-  int c = 0;
+  int c = 1;
   long offset = 0;
   *data_start = 0;
-  bool first = true;
-  while (fgets(buf, sizeof(buf), f)) {
-    size_t len = strlen(buf);
-    if (first) {
-      // Column count from the first line.
-      c = 1;
-      for (size_t i = 0; i < len; i++)
-        if (buf[i] == ',') c++;
-      // Header? first char not numeric/[-+.].
-      char ch = buf[0];
-      bool header = !(ch == '-' || ch == '+' || ch == '.' ||
-                      (ch >= '0' && ch <= '9'));
-      if (header) *data_start = static_cast<long>(len);
-      else r++;
-      first = false;
-    } else if (len > 1) {
-      r++;
+  bool in_first_line = true;
+  bool first_line_header = false;
+  bool seen_any_char = false;
+  bool line_has_data = false;
+  size_t len;
+  while ((len = fread(buf, 1, sizeof(buf), f)) > 0) {
+    for (size_t i = 0; i < len; i++) {
+      char ch = buf[i];
+      if (!seen_any_char) {
+        first_line_header = !(ch == '-' || ch == '+' || ch == '.' ||
+                              (ch >= '0' && ch <= '9'));
+        seen_any_char = true;
+      }
+      if (ch == '\n') {
+        if (in_first_line) {
+          if (first_line_header)
+            *data_start = offset + static_cast<long>(i) + 1;
+          else if (line_has_data)
+            r++;
+          in_first_line = false;
+        } else if (line_has_data) {
+          r++;
+        }
+        line_has_data = false;
+      } else {
+        if (in_first_line && ch == ',') c++;
+        if (ch != '\r') line_has_data = true;
+      }
     }
     offset += static_cast<long>(len);
   }
   fclose(f);
+  // Final line without a trailing newline.
+  if (line_has_data && !(in_first_line && first_line_header)) r++;
   *rows = r;
   *cols = c;
   return 0;
 }
 
 void parse_chunk(const char *data, size_t begin, size_t end, size_t total,
-                 long row_begin, int cols, int label_col, float *out,
-                 float *labels) {
+                 long row_begin, long rows, int cols, int label_col,
+                 float *out, float *labels) {
   // Advance to the start of the next full line unless at a boundary.
   size_t pos = begin;
   if (pos != 0) {
     while (pos < end && data[pos - 1] != '\n') pos++;
   }
   long row = row_begin;
+  int out_cols = (label_col >= 0 ? cols - 1 : cols);
   while (pos < total && pos < end) {
-    // Parse one line.
-    int col = 0, out_col = 0;
-    const char *p = data + pos;
-    char *next = nullptr;
-    while (col < cols) {
-      float v = strtof(p, &next);
-      if (next == p) break;
-      if (col == label_col && labels) {
-        labels[row] = v;
-      } else {
-        out[row * (label_col >= 0 ? cols - 1 : cols) + out_col] = v;
-        out_col++;
-      }
-      p = next;
-      if (*p == ',') p++;
-      col++;
+    // Find this line's extent and whether it holds any data; blank
+    // lines are not rows (matching scan_dims/rows_before).
+    size_t eol = pos;
+    bool has_data = false;
+    while (eol < total && data[eol] != '\n') {
+      if (data[eol] != '\r') has_data = true;
+      eol++;
     }
-    while (pos < total && data[pos] != '\n') pos++;
-    pos++;  // past newline
-    row++;
+    if (has_data) {
+      if (row >= rows) break;  // never write past the caller's buffers
+      int col = 0, out_col = 0;
+      bool label_set = false;
+      const char *p = data + pos;
+      const char *line_end = data + eol;
+      char *next = nullptr;
+      while (col < cols) {
+        float v = strtof(p, &next);
+        // strtof skips whitespace including newlines: reject a parse
+        // that escaped this line (short/malformed row).
+        if (next == p || next > line_end) break;
+        if (col == label_col && labels) {
+          labels[row] = v;
+          label_set = true;
+        } else if (out_col < out_cols) {
+          out[row * out_cols + out_col] = v;
+          out_col++;
+        }
+        p = next;
+        if (p < line_end && *p == ',') p++;
+        col++;
+      }
+      // Short/malformed rows: zero-fill the remainder so callers
+      // (who pass uninitialized buffers) see deterministic values.
+      for (; out_col < out_cols; out_col++) out[row * out_cols + out_col] = 0.0f;
+      if (labels && label_col >= 0 && !label_set) labels[row] = 0.0f;
+      row++;
+    }
+    pos = eol + 1;  // past newline (or to total at EOF)
   }
 }
 
-// Row index at a byte offset: count newlines before it.
+// Data-row index at a byte offset: lines with content before it.
 long rows_before(const char *data, size_t upto) {
   long n = 0;
-  for (size_t i = 0; i < upto; i++)
-    if (data[i] == '\n') n++;
+  bool line_has_data = false;
+  for (size_t i = 0; i < upto; i++) {
+    if (data[i] == '\n') {
+      if (line_has_data) n++;
+      line_has_data = false;
+    } else if (data[i] != '\r') {
+      line_has_data = true;
+    }
+  }
   return n;
 }
 
@@ -129,7 +175,8 @@ long rowpack_parse(const char *path, float *out, long rows, int cols,
   // Skip a header line if present.
   size_t start = 0;
   char ch = data[0];
-  if (!(ch == '-' || ch == '+' || ch == '.' || (ch >= '0' && ch <= '9'))) {
+  if (size > 0 &&
+      !(ch == '-' || ch == '+' || ch == '.' || (ch >= '0' && ch <= '9'))) {
     while (start < static_cast<size_t>(size) && data[start] != '\n') start++;
     start++;
   }
@@ -138,20 +185,43 @@ long rowpack_parse(const char *path, float *out, long rows, int cols,
       std::max(1u, std::thread::hardware_concurrency()));
   size_t span = (static_cast<size_t>(size) - start) /
                     static_cast<size_t>(nthreads) + 1;
-  std::vector<std::thread> workers;
-  for (int t = 0; t < nthreads; t++) {
-    size_t begin = start + static_cast<size_t>(t) * span;
-    size_t end = std::min(static_cast<size_t>(size), begin + span);
-    if (begin >= static_cast<size_t>(size)) break;
-    // Row index where this chunk's first full line starts.
-    size_t aligned = begin;
-    if (aligned != start) {
-      while (aligned < end && data[aligned - 1] != '\n') aligned++;
+
+  // Newline-aligned chunk bounds: every line belongs to exactly one
+  // chunk, so per-chunk row counts can run in parallel and a prefix
+  // sum yields each chunk's starting row — one parallel pass instead
+  // of an O(nthreads * file) serial rescan per chunk.
+  std::vector<size_t> bounds{start};
+  for (int t = 1; t < nthreads; t++) {
+    size_t b = start + static_cast<size_t>(t) * span;
+    if (b >= static_cast<size_t>(size)) break;
+    while (b < static_cast<size_t>(size) && data[b - 1] != '\n') b++;
+    if (b > bounds.back() && b < static_cast<size_t>(size)) bounds.push_back(b);
+  }
+  bounds.push_back(static_cast<size_t>(size));
+  int nchunks = static_cast<int>(bounds.size()) - 1;
+
+  std::vector<long> counts(static_cast<size_t>(nchunks), 0);
+  {
+    std::vector<std::thread> counters;
+    for (int i = 0; i + 1 < nchunks; i++) {  // last chunk's count unused
+      counters.emplace_back([&, i] {
+        counts[static_cast<size_t>(i)] =
+            rows_before(data.data() + bounds[static_cast<size_t>(i)],
+                        bounds[static_cast<size_t>(i) + 1] -
+                            bounds[static_cast<size_t>(i)]);
+      });
     }
-    long row_begin = rows_before(data.data() + start, aligned - start);
-    workers.emplace_back(parse_chunk, data.data(), begin, end,
-                         static_cast<size_t>(size), row_begin, cols,
+    for (auto &w : counters) w.join();
+  }
+
+  std::vector<std::thread> workers;
+  long row_begin = 0;
+  for (int i = 0; i < nchunks; i++) {
+    workers.emplace_back(parse_chunk, data.data(), bounds[static_cast<size_t>(i)],
+                         bounds[static_cast<size_t>(i) + 1],
+                         static_cast<size_t>(size), row_begin, rows, cols,
                          label_col, out, labels);
+    row_begin += counts[static_cast<size_t>(i)];
   }
   for (auto &w : workers) w.join();
   return rows;

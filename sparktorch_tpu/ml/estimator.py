@@ -191,7 +191,8 @@ class SparkTorch(Estimator):
                               "early-stop patience (-1 disables)",
                               TypeConverters.toInt)
     miniBatch = Param(Params._dummy(), "miniBatch",
-                      "global minibatch size per step (-1 = full batch)",
+                      "minibatch size per data shard per step, like the "
+                      "reference's per-partition sampling (-1 = full batch)",
                       TypeConverters.toInt)
     validationPct = Param(Params._dummy(), "validationPct",
                           "validation split fraction", TypeConverters.toFloat)

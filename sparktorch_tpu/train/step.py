@@ -107,17 +107,15 @@ def make_train_step(
     "long label retry" is gone because losses promote dtypes at trace
     time (see utils/losses.py).
 
-    ``mini_batch`` is the GLOBAL minibatch size (the reference's
-    ``miniBatch`` is per-worker on a per-partition loop; here configs
-    port unchanged because world-total examples per step match): each
-    shard samples ``ceil(mini_batch / n_batch_shards)`` rows locally.
+    ``mini_batch`` is PER batch-shard, exactly the reference's
+    per-partition semantics (``distributed.py:146-149``): each shard
+    samples ``mini_batch`` rows without replacement from its resident
+    data, so world-total examples per step = mini_batch * n_shards and
+    ported configs keep their training dynamics.
     """
-    n_shards = 1
-    for ax in axis_names:
-        n_shards *= mesh.shape[ax]
     per_shard_mb = None
     if mini_batch is not None and mini_batch > 0:
-        per_shard_mb = max(1, -(-mini_batch // n_shards))
+        per_shard_mb = mini_batch
 
     def shard_step(state: TrainState, batch: DataBatch):
         # Per-shard sampling key: replicated rng folded with the shard
@@ -203,13 +201,11 @@ def make_train_epoch(
     reference pays a Python iteration + a per-parameter gloo collective
     per step (``distributed.py:141-204``); here a whole epoch chunk is
     a single XLA program. Returns stacked per-step metrics.
+    ``mini_batch`` is per batch-shard (see ``make_train_step``).
     """
-    n_shards = 1
-    for ax in axis_names:
-        n_shards *= mesh.shape[ax]
     per_shard_mb = None
     if mini_batch is not None and mini_batch > 0:
-        per_shard_mb = max(1, -(-mini_batch // n_shards))
+        per_shard_mb = mini_batch
 
     def shard_epoch(state: TrainState, batch: DataBatch):
         shard_id = jnp.zeros((), jnp.int32)

@@ -144,12 +144,14 @@ def pad_to_multiple(batch: DataBatch, multiple: int) -> DataBatch:
 def sample_minibatch(
     batch: DataBatch, rng: jax.Array, mini_batch: int
 ) -> DataBatch:
-    """Uniform with-replacement minibatch sampling, traceable under jit.
+    """Uniform without-replacement minibatch sampling, traceable under jit.
 
     Parity: the reference samples ``random.sample(range(len), mini_batch)``
-    per step (``distributed.py:146-149``). Sampling happens inside the
-    compiled step (static output shape) so the hot loop stays on-device.
+    per step (``distributed.py:146-149``) — without replacement. A
+    permutation prefix reproduces that exactly; sampling happens inside
+    the compiled step (static output shape) so the hot loop stays
+    on-device.
     """
     n = batch.size
-    idx = jax.random.randint(rng, (mini_batch,), 0, n)
+    idx = jax.random.permutation(rng, n)[:mini_batch]
     return DataBatch(batch.x[idx], batch.y[idx], batch.w[idx])

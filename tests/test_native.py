@@ -120,3 +120,98 @@ def test_rowpack_no_header_no_label(tmp_path):
 def test_rowpack_missing_file():
     with pytest.raises(FileNotFoundError):
         read_csv("/nonexistent/file.csv")
+
+
+def test_rowpack_blank_lines_mid_file(tmp_path):
+    # Blank/short lines mid-file must not shift row indices (the OOB
+    # heap-write hazard: counting skipped them but parsing didn't).
+    path = tmp_path / "gaps.csv"
+    rows = [[float(i * 10 + j) for j in range(4)] for i in range(12)]
+    with open(path, "w") as f:
+        f.write("a,b,c,d\n")
+        for i, r in enumerate(rows):
+            f.write(",".join(str(v) for v in r) + "\n")
+            if i in (2, 3, 7):
+                f.write("\n")       # blank line
+            if i == 5:
+                f.write("\r\n")     # CRLF-blank line
+    x, y = read_csv(str(path), nthreads=4)
+    assert y is None
+    assert x.shape == (12, 4)
+    np.testing.assert_allclose(x, np.asarray(rows, np.float32))
+
+
+def test_rowpack_short_row_zero_filled(tmp_path):
+    # A malformed/short row must yield deterministic zeros, not
+    # uninitialized memory (callers pass np.empty buffers).
+    path = tmp_path / "short.csv"
+    with open(path, "w") as f:
+        f.write("1.0,2.0,3.0,4.0\n5.0,6.0\n7.0,8.0,9.0,10.0\n")
+    x, y = read_csv(str(path))
+    assert x.shape == (3, 4)
+    np.testing.assert_allclose(x[1], [5.0, 6.0, 0.0, 0.0])
+
+
+def test_rowpack_no_trailing_newline(tmp_path):
+    path = tmp_path / "nonl.csv"
+    with open(path, "w") as f:
+        f.write("1.0,2.0\n3.0,4.0")  # EOF without newline
+    x, y = read_csv(str(path))
+    assert x.shape == (2, 2)
+    np.testing.assert_allclose(x, [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_gang_dial_hostname():
+    # Coordinator host commonly arrives as a hostname (e.g. Spark's
+    # spark.driver.host), not an IPv4 literal — dial must resolve it.
+    with GangCoordinator(world_size=1) as coord:
+        w = GangWorker("localhost", coord.port, 0, "a:1")
+        w.barrier(0)
+        w.close()
+
+
+def test_gang_stop_with_wedged_client_does_not_hang():
+    # A worker that dies without closing its socket leaves a handler
+    # thread blocked in recv(); stop() must shut those sockets down and
+    # return promptly instead of wedging the driver.
+    coord = GangCoordinator(world_size=2)
+    w0 = GangWorker("127.0.0.1", coord.port, 0, "a:1")
+    # w1 registers but then goes silent with the socket held open.
+    w1 = GangWorker("127.0.0.1", coord.port, 1, "b:1")
+    w1.suspend_heartbeat()
+
+    done = threading.Event()
+
+    def stopper():
+        coord.stop()
+        done.set()
+
+    t = threading.Thread(target=stopper)
+    t.start()
+    t.join(timeout=5)
+    assert done.is_set(), "gang_server_stop hung on a wedged client"
+    w0.close()
+    w1.close()
+
+
+def test_gang_stop_releases_barrier_with_error():
+    # Waiters released by coordinator shutdown (world never completed)
+    # must see a failure, not a spurious GO into a hanging collective.
+    coord = GangCoordinator(world_size=2)
+    w0 = GangWorker("127.0.0.1", coord.port, 0, "a:1")
+    err = []
+
+    def waiter():
+        try:
+            w0.barrier(0)  # rank 1 never arrives
+        except GangFailure as e:
+            err.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    coord.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert err, "expected GangFailure on shutdown-released barrier"
+    w0.close()

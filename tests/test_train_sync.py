@@ -56,6 +56,9 @@ def test_minibatch_mode():
     result = train_distributed(payload, x, labels=y, iters=20, mini_batch=16)
     losses = [m["loss"] for m in result.metrics]
     assert losses[-1] < losses[0]
+    # mini_batch is PER SHARD (reference per-partition semantics,
+    # distributed.py:146-149): 8 shards x 16 = 128 examples per step.
+    assert result.metrics[0]["examples"] == 16.0 * 8
 
 
 def test_validation_split_and_early_stop():
