@@ -1,12 +1,12 @@
-"""Fused (flash) attention forward kernel in Pallas for TPU.
+"""Fused (flash) attention in Pallas for TPU — forward and backward.
 
 The hot op of the transformer family. One kernel fuses QK^T, the
 streaming softmax and the PV contraction, so the (seq x seq) logits
 matrix never hits HBM — the classic flash-attention recipe laid out
 on the TPU grid:
 
-- grid = (batch*heads, q_blocks, k_blocks); the innermost (k) axis
-  iterates sequentially per TPU core, so VMEM scratch (acc, m, l)
+- forward grid = (batch*heads, q_blocks, k_blocks); the innermost (k)
+  axis iterates sequentially per TPU core, so VMEM scratch (acc, m, l)
   persists across k blocks and accumulates the streaming softmax.
 - Q/K/V blocks stream HBM -> VMEM via BlockSpecs; both matmuls hit
   the MXU with float32 accumulation (bf16 inputs fine).
@@ -14,11 +14,17 @@ on the TPU grid:
   (`@pl.when`), and applies the in-block triangle mask on the
   diagonal blocks.
 
-On non-TPU backends (tests run on the CPU mesh) the kernel runs in
-Pallas interpret mode; shapes that don't tile (seq not a multiple of
-the block size) fall back to the XLA dense path. The backward pass
-recomputes through :func:`dense_attention` (memory-saving backward
-kernel is future work; forward inference/serving gets the full win).
+The backward is the flash-attention-2 recipe, also in Pallas: the
+forward additionally emits the per-row logsumexp, and two streaming
+kernels recompute p = exp(s - lse) block-by-block in VMEM —
+dq accumulates over k blocks, dk/dv accumulate over q blocks — so
+training never materializes the (seq x seq) matrix either. (The
+round-1 version recomputed the backward through the dense path;
+this closes that gap.)
+
+On non-TPU backends (tests run on the CPU mesh) the kernels run in
+Pallas interpret mode; shapes that don't tile onto (8, 128) TPU
+blocks fall back to the XLA dense path in both directions.
 """
 
 from __future__ import annotations
@@ -40,9 +46,9 @@ from sparktorch_tpu.ops.attention import dense_attention
 _LANES = 128  # TPU lane width: last-dim tiling unit
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                      *, scale: float, causal: bool, block_q: int,
-                      block_k: int, n_k: int):
+def _fwd_body(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+              *, scale: float, causal: bool, block_q: int, block_k: int,
+              n_k: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -96,44 +102,172 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-20)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = m_ref[:, :1] + jnp.log(l)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_fwd(q3, k3, v3, *, causal: bool, block_q: int, block_k: int,
-               interpret: bool):
-    """q3/k3/v3: (bh, seq, d_padded)."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, **kw):
+    _fwd_body(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref, **kw)
+
+
+def _fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                    l_ref, **kw):
+    _fwd_body(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, **kw)
+
+
+def _flash_fwd(q3, k3, v3, *, scale: float, causal: bool, block_q: int,
+               block_k: int, interpret: bool, with_lse: bool):
+    """q3/k3/v3: (bh, seq, d_padded). Returns out3 or (out3, lse3)."""
     bh, s_q, d = q3.shape
     s_k = k3.shape[1]
-    scale = 1.0 / (d ** 0.5)
     n_q = s_q // block_q
     n_k = s_k // block_k
 
-    kernel = functools.partial(
-        _flash_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, n_k=n_k,
-    )
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              n_k=n_k)
     grid = (bh, n_q, n_k)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))
+    lse_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, qi, ki: (b, qi, 0))
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, _LANES), jnp.float32),
+        pltpu.VMEM((block_q, _LANES), jnp.float32),
+    ]
+    if with_lse:
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_lse, **kw),
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, s_q, d), q3.dtype),
+                jax.ShapeDtypeStruct((bh, s_q, _LANES), jnp.float32),
+            ],
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[o_spec, lse_spec],
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(q3, k3, v3)
     return pl.pallas_call(
-        kernel,
+        functools.partial(_fwd_kernel, **kw),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q3.dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=o_spec,
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q3, k3, v3)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
+                   dq_acc, *, scale: float, causal: bool, block_q: int,
+                   block_k: int, n_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # exact softmax block, VMEM-only
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d_ref[0][:, :1])
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, scale: float, causal: bool,
+                    block_q: int, block_k: int, n_q: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        # dv += p^T @ do — contract the q axis, no explicit transpose.
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d_ref[0][:, :1])
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _tileable(s_q: int, s_k: int, block_q: int, block_k: int) -> bool:
-    return s_q % block_q == 0 and s_k % block_k == 0 and (
-        not (s_q == s_k) or block_q == block_k or True
+    """Kernel path only for shapes that land on TPU (sublane, lane)
+    tiles: block_q rows of 8, block_k lanes of 128."""
+    return (
+        s_q % block_q == 0 and s_k % block_k == 0
+        and block_q % 8 == 0 and block_k % _LANES == 0
     )
 
 
@@ -151,48 +285,137 @@ def flash_attention(
     to the 128-lane width inside (free for the math: zero dims add
     nothing to QK^T, and padded output dims are sliced away).
     """
-    return _flash_impl(q, k, v, causal, block_q, block_k)
+    out, _ = _flash_impl(q, k, v, causal, block_q, block_k, with_lse=False)
+    return out
 
 
-def _flash_impl(q, k, v, causal, block_q, block_k):
+def _to3(x, b, h, d):
+    x = jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+    if d % _LANES:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, _LANES - d % _LANES)))
+    return x
+
+
+def _from3(x3, b, h, d):
+    x = x3[:, :, :d].reshape(b, h, -1, d)
+    return jnp.swapaxes(x, 1, 2)
+
+
+def _flash_impl(q, k, v, causal, block_q, block_k, with_lse):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
-    if not _tileable(s_q, s_k, block_q, block_k):
-        return dense_attention(q, k, v, causal=causal)
+    if not _tileable(s_q, s_k, block_q, block_k) or pltpu is None:
+        return dense_attention(q, k, v, causal=causal), None
 
-    interpret = jax.default_backend() != "tpu" or pltpu is None
+    interpret = jax.default_backend() != "tpu"
+    # Softmax scale from the TRUE head_dim; zero-padding the lane dim
+    # does not change QK^T, so no rescaling trick is needed.
+    scale = d ** -0.5
+    out3 = _flash_fwd(
+        _to3(q, b, h, d), _to3(k, b, h, d), _to3(v, b, h, d),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, with_lse=with_lse,
+    )
+    if with_lse:
+        out3, lse3 = out3
+        # Keep only one lane in the residual: the kernel wrote lse
+        # broadcast across all 128 lanes, and holding that from forward
+        # to backward would pin a 128x-redundant tensor in HBM.
+        return _from3(out3, b, h, d), lse3[:, :, :1]
+    return _from3(out3, b, h, d), None
 
-    def to3(x):
-        x = jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
-        if d % _LANES:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, _LANES - d % _LANES)))
-        return x
 
-    # NOTE: padded head_dim changes the softmax scale basis; keep the
-    # scale computed from the PADDED d inside the kernel consistent by
-    # pre-scaling q to the true-d scale.
-    d_pad = d if d % _LANES == 0 else d + (_LANES - d % _LANES)
-    q = q * (d_pad ** 0.5) * (d ** -0.5)
+def _flash_bwd_impl(q, k, v, out, lse3, g, causal, block_q, block_k):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    scale = d ** -0.5
+    interpret = jax.default_backend() != "tpu"
 
-    out3 = _flash_fwd(to3(q), to3(k), to3(v), causal=causal,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
-    out = out3[:, :, :d].reshape(b, h, s_q, d)
-    return jnp.swapaxes(out, 1, 2)
+    q3 = _to3(q, b, h, d)
+    k3 = _to3(k, b, h, d)
+    v3 = _to3(v, b, h, d)
+    do3 = _to3(g, b, h, d)
+    o3 = _to3(out, b, h, d)
+    bh, _, d_pad = q3.shape
+    n_q = s_q // block_q
+    n_k = s_k // block_k
+
+    # D_i = dO_i . O_i (padded dims are zero, so padding is harmless).
+    di = jnp.sum(o3.astype(jnp.float32) * do3.astype(jnp.float32), axis=-1)
+    di3 = jnp.broadcast_to(di[..., None], (bh, s_q, _LANES))
+    lse3 = jnp.broadcast_to(lse3, (bh, s_q, _LANES))  # single-lane residual
+
+    row_spec = pl.BlockSpec((1, block_q, _LANES), lambda bb, qi, ki: (bb, qi, 0))
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d_pad), q3.dtype),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda bb, qi, ki: (bb, qi, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bb, qi, ki: (bb, ki, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bb, qi, ki: (bb, ki, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda bb, qi, ki: (bb, qi, 0)),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda bb, qi, ki: (bb, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, di3)
+
+    row_spec_kv = pl.BlockSpec((1, block_q, _LANES), lambda bb, ki, qi: (bb, qi, 0))
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d_pad), k3.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d_pad), v3.dtype),
+        ],
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda bb, ki, qi: (bb, qi, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bb, ki, qi: (bb, ki, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bb, ki, qi: (bb, ki, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda bb, ki, qi: (bb, qi, 0)),
+            row_spec_kv,
+            row_spec_kv,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_pad), lambda bb, ki, qi: (bb, ki, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bb, ki, qi: (bb, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, di3)
+
+    return (
+        _from3(dq3, b, h, d).astype(q.dtype),
+        _from3(dk3, b, h, d).astype(k.dtype),
+        _from3(dv3, b, h, d).astype(v.dtype),
+    )
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    out = _flash_impl(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+    out, lse3 = _flash_impl(q, k, v, causal, block_q, block_k, with_lse=True)
+    return out, (q, k, v, out, lse3)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, res, g):
-    # Memory-simple backward: recompute through the dense path.
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse3 = res
+    if lse3 is None:  # dense fallback took the forward too
+        _, vjp = jax.vjp(
+            lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v
+        )
+        return vjp(g)
+    return _flash_bwd_impl(q, k, v, out, lse3, g, causal, block_q, block_k)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

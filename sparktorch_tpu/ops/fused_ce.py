@@ -1,13 +1,18 @@
-"""Fused softmax cross-entropy in Pallas.
+"""Fused softmax cross-entropy in Pallas — forward AND backward.
 
 For LM training the naive path materializes (tokens, vocab) softmax
-probabilities in HBM. This kernel streams vocab blocks through VMEM,
-carrying a running (max, sum-exp, picked-logit) per token — the loss
-comes out without the probability matrix ever existing. Backward uses
-the analytic gradient (softmax - onehot), which XLA fuses well.
+probabilities in HBM. The forward kernel streams vocab blocks through
+VMEM, carrying a running (max, sum-exp, picked-logit) per token — the
+loss comes out without the probability matrix ever existing. The
+backward saves only the per-token logsumexp and recomputes
+``(softmax - onehot) * g`` per vocab block in VMEM, writing straight
+into the (tokens, vocab) logit gradient (which must exist anyway) —
+so neither direction ever holds a separate probability matrix in HBM.
 
-grid = (token_blocks, vocab_blocks); innermost axis iterates
-sequentially so VMEM scratch accumulates across vocab blocks.
+Forward grid = (token_blocks, vocab_blocks); innermost axis iterates
+sequentially so VMEM scratch accumulates across vocab blocks. The
+backward grid has no cross-block carry (lse is known), so blocks are
+fully parallel.
 """
 
 from __future__ import annotations
@@ -104,17 +109,61 @@ def _ce_impl(logits, labels, block_t, block_v):
     return out[:, 0]
 
 
+def _ce_bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, out_ref,
+                   *, block_v: int):
+    vi = pl.program_id(1)
+    s = logits_ref[:].astype(jnp.float32)  # (block_t, block_v)
+    lse = lse_ref[:, :1]
+    gg = g_ref[:, :1]
+    labels = labels_ref[:, :1]
+    col = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    probs = jnp.exp(s - lse)  # softmax block, lives only in VMEM
+    grad = (probs - (col == labels).astype(jnp.float32)) * gg
+    out_ref[:] = grad.astype(out_ref.dtype)
+
+
 def _ce_fwd(logits, labels, block_t, block_v):
-    return _ce_impl(logits, labels, block_t, block_v), (logits, labels)
+    loss = _ce_impl(logits, labels, block_t, block_v)
+    return loss, (logits, labels, loss)
 
 
 def _ce_bwd(block_t, block_v, res, g):
-    logits, labels = res
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    onehot = jax.nn.one_hot(labels.astype(jnp.int32), logits.shape[-1],
-                            dtype=jnp.float32)
-    grad = (probs - onehot) * g[:, None]
-    return grad.astype(logits.dtype), None
+    logits, labels, loss = res
+    t, v = logits.shape
+    bt = min(block_t, t)
+    bv = min(block_v, v)
+    labels_i = labels.astype(jnp.int32)
+    # lse = loss + picked logit (by definition loss = lse - picked);
+    # recovering it costs one (t,)-gather instead of a saved residual.
+    picked = jnp.take_along_axis(
+        logits.astype(jnp.float32), labels_i[:, None], axis=-1
+    )[:, 0]
+    lse = loss + picked
+
+    if t % bt or v % bv or pltpu is None:
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        onehot = jax.nn.one_hot(labels_i, v, dtype=jnp.float32)
+        return ((probs - onehot) * g[:, None]).astype(logits.dtype), None
+
+    interpret = jax.default_backend() != "tpu"
+    labels2 = jnp.broadcast_to(labels_i[:, None], (t, _LANES))
+    lse2 = jnp.broadcast_to(lse[:, None], (t, _LANES))
+    g2 = jnp.broadcast_to(g.astype(jnp.float32)[:, None], (t, _LANES))
+    kernel = functools.partial(_ce_bwd_kernel, block_v=bv)
+    grad = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((t, v), logits.dtype),
+        grid=(t // bt, v // bv),
+        in_specs=[
+            pl.BlockSpec((bt, bv), lambda ti, vi: (ti, vi)),
+            pl.BlockSpec((bt, _LANES), lambda ti, vi: (ti, 0)),
+            pl.BlockSpec((bt, _LANES), lambda ti, vi: (ti, 0)),
+            pl.BlockSpec((bt, _LANES), lambda ti, vi: (ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, bv), lambda ti, vi: (ti, vi)),
+        interpret=interpret,
+    )(logits, labels2, lse2, g2)
+    return grad, None
 
 
 fused_cross_entropy.defvjp(_ce_fwd, _ce_bwd)

@@ -56,6 +56,16 @@ from sparktorch_tpu.ml.estimator import _decode_bundle, _encode_bundle
 from sparktorch_tpu.utils.serde import deserialize_model
 
 
+def _labels_to_f32(values, label_col) -> np.ndarray:
+    try:
+        return np.asarray(values, dtype=np.float32)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"labelCol {label_col!r} must be numeric; index string "
+            "labels first (e.g. StringIndexer)"
+        ) from e
+
+
 class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
     """The reference's 14 declared Params (torch_distributed.py:141-154)
     plus deployMode."""
@@ -123,7 +133,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
         x = np.stack([np.asarray(r[0], dtype=np.float32)
                       if not hasattr(r[0], "toArray")
                       else r[0].toArray().astype(np.float32) for r in rows])
-        y = np.asarray([r[1] for r in rows], dtype=np.float32) if label else None
+        y = _labels_to_f32([r[1] for r in rows], label) if label else None
         return x, y
 
     # -- fit ---------------------------------------------------------------
@@ -213,11 +223,12 @@ class SparkTorch(Estimator, _SparkTorchParams):
             rdd = rdd.repartition(n_hosts)
 
         # The coordinator runs HERE on the driver; barrier tasks must
-        # not start their own (start_coordinator=False below).
+        # not start their own (start_coordinator=False below). Port 0 =
+        # ephemeral: two concurrent fits on one driver cannot collide;
+        # the bound port travels to the tasks in the closure.
         from sparktorch_tpu.native.gang import GangCoordinator
-        from sparktorch_tpu.parallel.launch import DEFAULT_GANG_PORT
 
-        coord = GangCoordinator(world_size=n_hosts, port=DEFAULT_GANG_PORT)
+        coord = GangCoordinator(world_size=n_hosts, port=0)
         gang_port = coord.port
 
         def run_host(iterator):
@@ -232,7 +243,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
                 else r[0].toArray().astype(np.float32)
                 for r in rows
             ]) if rows else np.zeros((0, 1), np.float32)
-            y = (np.asarray([r[1] for r in rows], dtype=np.float32)
+            y = (_labels_to_f32([r[1] for r in rows], label)
                  if rows and label else None)
 
             from sparktorch_tpu.parallel.launch import bringup_multihost

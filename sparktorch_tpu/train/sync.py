@@ -169,6 +169,10 @@ def train_distributed(
     # step-per-call path.
     if steps_per_call is None:
         steps_per_call = 1 if (stopper is not None or val_batch is not None) else min(iters, 32)
+        if ckpt is not None and checkpoint_every > 0:
+            # Keep chunk boundaries at least as frequent as the
+            # checkpoint cadence (saves happen between compiled calls).
+            steps_per_call = min(steps_per_call, checkpoint_every)
     steps_per_call = max(1, min(steps_per_call, iters))
     # Chunks must divide iters exactly (a fused call always runs its
     # full scan; overshooting would silently train extra steps).
@@ -192,6 +196,7 @@ def train_distributed(
 
     recorder = MetricsRecorder(n_chips=mesh.size)
     metrics = recorder.records
+    last_ckpt_step = int(jax.device_get(state.step)) if ckpt is not None else 0
     shuffle_key = jax.random.key(seed + 1)
     profiler = profile_run(profile_dir)
     profiler.__enter__()
@@ -261,9 +266,13 @@ def train_distributed(
                         break
                 i += 1
             if ckpt is not None and checkpoint_every > 0:
+                # Save on the first chunk boundary at or past the
+                # cadence — a fused chunk that strides over the exact
+                # multiple must not silently skip the save.
                 step_now = int(jax.device_get(state.step))
-                if step_now % checkpoint_every == 0:
+                if step_now - last_ckpt_step >= checkpoint_every:
                     ckpt.save(step_now, state)
+                    last_ckpt_step = step_now
             if stop:
                 break
         if stop:

@@ -49,6 +49,10 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Retained checkpoint steps (bounded by ``max_to_keep``)."""
+        return list(self._mgr.all_steps())
+
     def restore(self, abstract_state: TrainState,
                 step: Optional[int] = None) -> TrainState:
         """Restore into the layout described by ``abstract_state``

