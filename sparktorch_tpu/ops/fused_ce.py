@@ -31,6 +31,12 @@ except ImportError:  # pragma: no cover
 _LANES = 128
 
 
+def _use_kernel(t: int, v: int, block_t: int, block_v: int) -> bool:
+    """One predicate for BOTH directions — forward and backward must
+    always pick the same path (kernel vs dense fallback)."""
+    return pltpu is not None and t % block_t == 0 and v % block_v == 0
+
+
 def _ce_kernel(logits_ref, labels_ref, loss_ref, m_ref, l_ref, p_ref,
                *, block_v: int, n_v: int):
     vi = pl.program_id(1)
@@ -79,7 +85,7 @@ def _ce_impl(logits, labels, block_t, block_v):
     t, v = logits.shape
     block_t = min(block_t, t)
     block_v = min(block_v, v)
-    if t % block_t or v % block_v or pltpu is None:
+    if not _use_kernel(t, v, block_t, block_v):
         logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
             logits.astype(jnp.float32), labels[:, None].astype(jnp.int32), axis=-1
@@ -140,7 +146,7 @@ def _ce_bwd(block_t, block_v, res, g):
     )[:, 0]
     lse = loss + picked
 
-    if t % bt or v % bv or pltpu is None:
+    if not _use_kernel(t, v, bt, bv):
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         onehot = jax.nn.one_hot(labels_i, v, dtype=jnp.float32)
         return ((probs - onehot) * g[:, None]).astype(logits.dtype), None

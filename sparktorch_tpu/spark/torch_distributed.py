@@ -243,8 +243,14 @@ class SparkTorch(Estimator, _SparkTorchParams):
                 else r[0].toArray().astype(np.float32)
                 for r in rows
             ]) if rows else np.zeros((0, 1), np.float32)
-            y = (_labels_to_f32([r[1] for r in rows], label)
-                 if rows and label else None)
+            if label:
+                # Empty partitions still declare the label axis so the
+                # cross-host shape agreement holds (weight-0 padding
+                # absorbs them — distributed.py:131-133 analog).
+                y = (_labels_to_f32([r[1] for r in rows], label)
+                     if rows else np.zeros((0,), np.float32))
+            else:
+                y = None
 
             from sparktorch_tpu.parallel.launch import bringup_multihost
             from sparktorch_tpu.train.sync import train_distributed_multihost

@@ -136,11 +136,16 @@ def train_distributed(
                              train_batch.x.dtype)
     else:
         sample_x = train_batch.x[:1]
+    # Initialize UNDER jit with replicated out_shardings: every process
+    # runs the same compiled init, so this works on multi-process
+    # (non-fully-addressable) meshes where a host-side device_put of
+    # replicated state cannot (the reference replicates the model onto
+    # every executor, distributed.py:112-115).
     with mesh:
-        state = create_train_state(spec, rng, sample_x=sample_x, tx=tx)
-    # Replicate state across the mesh (reference replicates the model
-    # onto every executor, distributed.py:112-115).
-    state = jax.device_put(state, replicated(mesh))
+        state = jax.jit(
+            lambda: create_train_state(spec, rng, sample_x=sample_x, tx=tx),
+            out_shardings=replicated(mesh),
+        )()
 
     ckpt = None
     if checkpoint_dir:
@@ -320,16 +325,39 @@ def train_distributed_multihost(
     local_x = np.asarray(local_x, dtype=np.float32)
     if local_x.ndim == 1:
         local_x = local_x.reshape(0, 1) if local_x.size == 0 else local_x[:, None]
+    local_y = np.asarray(local_y) if local_y is not None else None
+
+    # Agree on a common per-host row count AND feature shape (hosts
+    # must build identically-shaped local shards for the global array;
+    # an EMPTY host has no way to know the feature shape locally — the
+    # analog of the reference's empty-partition protocol,
+    # distributed.py:131-133). Fixed-width vector so the allgather
+    # lines up even when ranks differ across hosts.
+    _MAX_RANK = 8
+    if local_x.ndim - 1 > _MAX_RANK:
+        raise ValueError(f"feature rank {local_x.ndim - 1} > {_MAX_RANK}")
+    shape_vec = np.full((2 + _MAX_RANK,), 0, np.int64)
+    shape_vec[0] = local_x.shape[0]
+    feat = local_x.shape[1:]
+    shape_vec[1] = len(feat)
+    shape_vec[2 : 2 + len(feat)] = feat
+    gathered = multihost_utils.process_allgather(shape_vec)
+    gathered = gathered.reshape(-1, 2 + _MAX_RANK)
+    counts = gathered[:, 0]
+    if local_x.shape[0] == 0:
+        donors = gathered[gathered[:, 0] > 0]
+        if len(donors):
+            nd = int(donors[0, 1])
+            feat = tuple(int(v) for v in donors[0, 2 : 2 + nd])
+            local_x = np.zeros((0,) + feat, np.float32)
+            if local_y is not None:
+                local_y = np.zeros((0,) + tuple(local_y.shape[1:]),
+                                   local_y.dtype)
+    # Unsupervised (y=x) aliasing AFTER the donor repair, so the empty
+    # host's labels adopt the repaired feature shape too.
     if local_y is None:
         local_y = local_x
-    local_y = np.asarray(local_y)
     local_w = np.ones((local_x.shape[0],), np.float32)
-
-    # Agree on a common per-host row count (hosts must build
-    # identically-shaped local shards for the global array).
-    counts = multihost_utils.process_allgather(
-        np.asarray([local_x.shape[0]], np.int64)
-    ).reshape(-1)
     per_host = int(counts.max())
     # The global batch must divide the mesh's batch shards.
     n_shards = 1

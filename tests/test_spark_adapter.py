@@ -1,0 +1,155 @@
+"""The pyspark deployment adapter, exercised against the localspark
+runtime (sparktorch_tpu.spark.localsession) — the stand-in for the
+reference's "real local Spark session" test tier
+(tests/test_sparktorch.py:13-26: local[2] + 2 partitions).
+
+Key property: mapPartitions tasks run in SEPARATE PROCESSES, so the
+barrier-mode tests below really form a 2-process jax.distributed
+world over the native gang coordinator's TCP rendezvous.
+"""
+
+import numpy as np
+import pytest
+
+from sparktorch_tpu.spark import localsession
+
+assert localsession.install(), "real pyspark present? these tests target the shim"
+
+from sparktorch_tpu.spark.torch_distributed import SparkTorch, SparkTorchModel  # noqa: E402
+from sparktorch_tpu.models import Net, MnistMLP  # noqa: E402
+from sparktorch_tpu.utils.serde import serialize_model  # noqa: E402
+
+DenseVector = localsession.DenseVector
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = localsession.SparkSession.builder.master("local[2]").getOrCreate()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def data(spark):
+    """The reference's fixture dataset: two 200-row Gaussian blobs as
+    (label, DenseVector) rows, 2 partitions."""
+    rng = np.random.default_rng(42)
+    x0 = rng.normal(0.0, 1.0, (200, 10))
+    x1 = rng.normal(2.0, 1.0, (200, 10))
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(200), np.ones(200)])
+    perm = rng.permutation(400)
+    rows = [(float(y[i]), DenseVector(x[i])) for i in perm]
+    return spark.createDataFrame(rows, ["label", "features"]).repartition(2)
+
+
+def _estimator(**overrides):
+    payload = serialize_model(
+        Net(), "mse", "adam", {"lr": 1e-2}, input_shape=(10,)
+    )
+    kwargs = dict(
+        inputCol="features", labelCol="label", predictionCol="predictions",
+        torchObj=payload, iters=30, verbose=0,
+    )
+    kwargs.update(overrides)
+    return SparkTorch(**kwargs)
+
+
+def test_driver_mode_fit_transform(data):
+    model = _estimator().fit(data)
+    assert isinstance(model, SparkTorchModel)
+    res = model.transform(data).collect()
+    assert "predictions" in res[0].asDict()
+    preds = np.asarray([r["predictions"] for r in res])
+    labels = np.asarray([r["label"] for r in res])
+    acc = np.mean((preds > 0.5) == (labels > 0.5))
+    assert acc > 0.9, acc
+
+
+def test_vector_out(data):
+    payload = serialize_model(
+        MnistMLP(hidden=(16,), n_classes=2), "cross_entropy", "adam",
+        {"lr": 1e-2}, input_shape=(10,),
+    )
+    model = _estimator(torchObj=payload, useVectorOut=True).fit(data)
+    res = model.transform(data).collect()
+    vec = res[0]["predictions"]
+    assert len(vec) == 2  # raw logits vector (reference predict_vec)
+
+
+def test_classifier_argmax_predictions(data):
+    payload = serialize_model(
+        MnistMLP(hidden=(16,), n_classes=2), "cross_entropy", "adam",
+        {"lr": 1e-2}, input_shape=(10,),
+    )
+    model = _estimator(torchObj=payload, iters=40).fit(data)
+    res = model.transform(data).collect()
+    preds = np.asarray([r["predictions"] for r in res])
+    labels = np.asarray([r["label"] for r in res])
+    assert set(np.unique(preds)) <= {0.0, 1.0}
+    assert np.mean(preds == labels) > 0.9
+
+
+def test_string_labels_actionable_error(spark):
+    rows = [("a", DenseVector(np.zeros(4))), ("b", DenseVector(np.ones(4)))]
+    df = spark.createDataFrame(rows, ["label", "features"])
+    est = _estimator(iters=1)
+    with pytest.raises(ValueError, match="StringIndexer"):
+        est.fit(df)
+
+
+def test_hogwild_driver_mode(data):
+    model = _estimator(mode="hogwild", iters=30, miniBatch=64).fit(data)
+    res = model.transform(data).collect()
+    preds = np.asarray([r["predictions"] for r in res])
+    labels = np.asarray([r["label"] for r in res])
+    assert np.mean((preds > 0.5) == (labels > 0.5)) > 0.85
+
+
+def test_barrier_mode_rejects_hogwild(data):
+    est = _estimator(mode="hogwild", deployMode="barrier")
+    with pytest.raises(ValueError, match="barrier"):
+        est.fit(data)
+
+
+@pytest.mark.slow
+def test_barrier_mode_two_process_world(data):
+    """deployMode='barrier': 2 partitions -> 2 executor PROCESSES that
+    rendezvous through the native gang coordinator, run
+    jax.distributed.initialize, and train one global SPMD step stream
+    over a real 2-process CPU mesh."""
+    model = _estimator(deployMode="barrier", partitions=2, iters=25).fit(data)
+    res = model.transform(data).collect()
+    preds = np.asarray([r["predictions"] for r in res])
+    labels = np.asarray([r["label"] for r in res])
+    acc = np.mean((preds > 0.5) == (labels > 0.5))
+    assert acc > 0.9, acc
+
+
+@pytest.mark.slow
+def test_barrier_mode_empty_partition(spark):
+    """3 barrier tasks, 2 rows: one task has NO data and must still
+    enter the collectives (weight-0 shape agreement — the reference's
+    empty-partition protocol, distributed.py:131-133)."""
+    rng = np.random.default_rng(0)
+    rows = [(float(i % 2), DenseVector(rng.normal(i % 2, 0.1, 10)))
+            for i in range(2)]
+    df = spark.createDataFrame(rows, ["label", "features"]).repartition(3)
+    model = _estimator(deployMode="barrier", partitions=3, iters=2).fit(df)
+    res = model.transform(df).collect()
+    assert len(res) == 2 and "predictions" in res[0].asDict()
+
+
+def test_localsession_rdd_process_isolation(spark):
+    """mapPartitions really runs in separate processes (PIDs differ
+    from the driver) — the property the wire-level tests rely on."""
+    import os
+
+    df = spark.createDataFrame([(float(i), DenseVector([i]))
+                                for i in range(4)], ["label", "features"])
+    pids = df.repartition(2).rdd.mapPartitions(
+        lambda it: [__import__("os").getpid()]
+    ).collect()
+    assert len(pids) == 2
+    assert all(p != os.getpid() for p in pids)
+    assert pids[0] != pids[1]
