@@ -1,7 +1,14 @@
-"""Optional PySpark integration.
+"""PySpark deployment tier.
 
-Everything in this subpackage requires ``pyspark`` at import time; the
-core framework never imports it. The baked image for this repo does
-not ship pyspark, so these modules are exercised only in environments
-that provide it (the reference's deployment target).
+``torch_distributed`` / ``pipeline_util`` require a pyspark module at
+import time. On a Spark cluster that is the real thing; everywhere
+else :mod:`sparktorch_tpu.spark.localsession` provides a faithful
+API-compatible local runtime (real multi-process executors, barrier
+execution, pipeline persistence) — call ``localsession.install()``
+first and the adapter code runs unmodified. The core framework
+(:mod:`sparktorch_tpu.ml`) never imports any of this.
 """
+
+__all__ = ["localsession"]
+
+from sparktorch_tpu.spark import localsession  # noqa: E402

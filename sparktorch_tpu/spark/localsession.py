@@ -687,15 +687,17 @@ class Pipeline(Estimator):
         return self
 
     def _fit(self, dataset):
+        # pyspark semantics: transform feeds only LATER estimators, so
+        # stages at/after the last estimator are not transformed during
+        # fit (no wasted inference pass on the training set).
+        est_idx = [i for i, s in enumerate(self.stages) if hasattr(s, "fit")]
+        last_est = est_idx[-1] if est_idx else -1
         fitted = []
         df = dataset
-        for stage in self.stages:
-            if hasattr(stage, "fit"):
-                model = stage.fit(df)
-            else:
-                model = stage
+        for i, stage in enumerate(self.stages):
+            model = stage.fit(df) if hasattr(stage, "fit") else stage
             fitted.append(model)
-            if hasattr(model, "transform"):
+            if i < last_est and hasattr(model, "transform"):
                 df = model.transform(df)
         return PipelineModel(fitted)
 

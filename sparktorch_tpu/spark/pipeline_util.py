@@ -64,6 +64,37 @@ def is_carrier(stage) -> bool:
     return bool(words) and words[-1] == CARRIER_GUID
 
 
+class PythonStagePersistence:
+    """Mixin that lets a pure-Python pyspark stage survive
+    ``Pipeline.write().save(path)`` / ``PipelineModel.load(path)``.
+
+    Parity: the reference's ``PysparkReaderWriter`` (reference
+    ``pipeline_util.py:80-130``) — when the surrounding pipeline is
+    persisted, the stage converts itself into the JVM-persistable
+    carrier (a ``StopWordsRemover`` whose stopwords smuggle the dill
+    payload, tagged with the magic GUID); loading + ``unwrap`` (below)
+    restores the live Python object.
+
+    Two hooks cover both runtimes: real pyspark's ``JavaMLWriter``
+    calls ``_to_java`` (we build a real StopWordsRemover and delegate
+    to its own ``_to_java``); the localspark runtime's pipeline writer
+    calls ``_to_carrier``.
+    """
+
+    def _to_carrier(self):
+        return encode_python_stage(self, getattr(self, "uid", "pystage"))
+
+    def _to_java(self):  # pragma: no cover - needs a JVM gateway
+        return self._to_carrier()._to_java()
+
+    @classmethod
+    def _from_java(cls, java_stage):  # pragma: no cover - needs a JVM
+        py_carrier = StopWordsRemover()
+        py_carrier._java_obj = java_stage
+        py_carrier._transfer_params_from_java()
+        return decode_carrier_stage(py_carrier)
+
+
 def unwrap_spark_pipeline(pipeline):
     """Re-hydrate carrier stages in a loaded Spark pipeline.
 
@@ -85,3 +116,12 @@ def unwrap_spark_pipeline(pipeline):
         else:
             pipeline.stages = new_stages
     return pipeline
+
+
+class PysparkPipelineWrapper:
+    """Reference-named entry point (``pipeline_util.py:49-77``):
+    ``PysparkPipelineWrapper.unwrap(PipelineModel.load(path))``."""
+
+    @staticmethod
+    def unwrap(pipeline):
+        return unwrap_spark_pipeline(pipeline)

@@ -66,6 +66,18 @@ def _labels_to_f32(values, label_col) -> np.ndarray:
         ) from e
 
 
+def _rows_to_x(rows) -> np.ndarray:
+    """Stack row features (DenseVector or array-like) into a float32
+    matrix — the vectorized analog of the reference's per-row
+    ``row[input_col].toArray()`` (torch_distributed.py:43-55)."""
+    return np.stack([
+        np.asarray(r[0], dtype=np.float32)
+        if not hasattr(r[0], "toArray")
+        else r[0].toArray().astype(np.float32)
+        for r in rows
+    ])
+
+
 class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
     """The reference's 14 declared Params (torch_distributed.py:141-154)
     plus deployMode."""
@@ -130,9 +142,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
                  if self.isDefined(self.labelCol) else None)
         cols = [inp] + ([label] if label else [])
         rows = dataset.select(*cols).collect()
-        x = np.stack([np.asarray(r[0], dtype=np.float32)
-                      if not hasattr(r[0], "toArray")
-                      else r[0].toArray().astype(np.float32) for r in rows])
+        x = _rows_to_x(rows)
         y = _labels_to_f32([r[1] for r in rows], label) if label else None
         return x, y
 
@@ -140,7 +150,10 @@ class SparkTorch(Estimator, _SparkTorchParams):
 
     def _fit(self, dataset):
         if self.getOrDefault(self.deployMode) == "barrier":
-            result = self._fit_barrier(dataset)
+            if self.getOrDefault(self.mode) in ("hogwild", "async"):
+                result = self._fit_hogwild_executors(dataset)
+            else:
+                result = self._fit_barrier(dataset)
         else:
             result = self._fit_driver(dataset)
         return SparkTorchModel(
@@ -186,6 +199,150 @@ class SparkTorch(Estimator, _SparkTorchParams):
             )
         return _encode_bundle(result.spec, result.params, result.model_state)
 
+    def _fit_hogwild_executors(self, dataset) -> str:
+        """The reference's hogwild topology, executor-side: the DRIVER
+        hosts the parameter server (``ParamServerHttp``), executor
+        tasks run the async worker loop over the HTTP wire —
+        pull/grad/push per iteration with version-tagged pulls
+        (reference ``hogwild.py:65-142`` + ``torch_distributed.py:
+        310-334``).
+        """
+        inp = self.getOrDefault(self.inputCol)
+        label = (self.getOrDefault(self.labelCol)
+                 if self.isDefined(self.labelCol) else None)
+        torch_obj = self.getOrDefault(self.torchObj)
+        iters = self.getOrDefault(self.iters)
+        mini_batch = self.getOrDefault(self.miniBatch)
+        mini_batch = None if mini_batch <= 0 else mini_batch
+        shuffles = max(1, self.getOrDefault(self.partitionShuffles))
+        verbose = self.getOrDefault(self.verbose)
+        patience = self.getOrDefault(self.earlyStopPatience)
+        validation_pct = self.getOrDefault(self.validationPct)
+        # Explicitly-set port is honored (reference default 3000);
+        # otherwise ephemeral, so concurrent fits never collide.
+        port = self.getOrDefault(self.port) if self.isSet(self.port) else 0
+        lock = self.getOrDefault(self.acquireLock)
+        spark = dataset.sparkSession
+        driver_host = spark.conf.get("spark.driver.host", "127.0.0.1")
+        n_parts = (self.getOrDefault(self.partitions)
+                   if self.isDefined(self.partitions)
+                   else dataset.rdd.getNumPartitions())
+        base = dataset.select(*([inp] + ([label] if label else [])))
+
+        from sparktorch_tpu.serve.param_server import (
+            ParameterServer,
+            ParamServerHttp,
+        )
+
+        spec = deserialize_model(torch_obj)
+        if spec.input_shape is None:
+            first = dataset.select(inp).take(1)
+            if not first:
+                raise ValueError("cannot infer input shape from empty data")
+            v = first[0][0]
+            spec.input_shape = tuple(
+                np.asarray(v.toArray() if hasattr(v, "toArray") else v).shape
+            )
+
+        server = ParameterServer(
+            spec, window_len=n_parts, early_stop_patience=patience,
+            acquire_lock=lock, seed=0,
+        )
+        # Bind all interfaces (executors are remote); workers reach the
+        # driver through spark.driver.host.
+        http = ParamServerHttp(server, host="0.0.0.0", port=port).start()
+        url = f"http://{driver_host}:{http.port}"
+        early_stop = patience is not None and patience > 0
+
+        def make_run_worker(round_seed: int):
+            def run_worker(iterator):
+                rows = list(iterator)
+                if not rows:
+                    return  # hogwild has no collectives: empty task exits
+                import os as _os
+
+                import jax as _jax
+                import jax.numpy as _jnp
+
+                from sparktorch_tpu.train.hogwild import (
+                    HttpTransport,
+                    _worker_loop,
+                    make_grad_step,
+                )
+                from sparktorch_tpu.utils.data import handle_features
+                from sparktorch_tpu.utils.serde import (
+                    deserialize_model as _deserialize,
+                )
+
+                transport = HttpTransport(url)
+                assert transport.alive()  # GET / liveness (hogwild.py:60-62)
+                w_spec = _deserialize(torch_obj)
+                x = _rows_to_x(rows)
+                if w_spec.input_shape is None:
+                    w_spec.input_shape = tuple(x.shape[1:])
+                y = _labels_to_f32([r[1] for r in rows], label) if label else x
+                # Per-partition validation split, like the reference's
+                # executor-side handle_features (util.py:57-100).
+                shard, val_shard = handle_features(
+                    x, y, validation_pct, seed=round_seed
+                )
+                module = w_spec.make_module()
+                grad_step = make_grad_step(module.apply, w_spec.loss_fn())
+                variables = dict(w_spec.init_params(_jax.random.key(0)))
+                variables.pop("params", None)
+                records, errors = [], []
+                _worker_loop(
+                    _os.getpid() % 100000, _jax.devices()[0], transport,
+                    grad_step, variables, shard,
+                    _jax.device_put(val_shard, _jax.devices()[0])
+                    if val_shard is not None else None,
+                    iters, mini_batch, verbose, early_stop, round_seed,
+                    records, errors,
+                )
+                if errors:
+                    raise errors[0]
+                yield {
+                    "worker": _os.getpid(),
+                    "losses": [r["loss"] for r in records],
+                    "versions": [r["version"] for r in records],
+                }
+
+            return run_worker
+
+        try:
+            summaries = []
+            for round_idx in range(shuffles):  # hogwild.py:161-177 parity
+                # A fresh repartition per round moves rows between
+                # partitions on a real cluster's shuffle service (the
+                # reference's "partition shuffles"); the per-round seed
+                # additionally re-randomizes every worker's minibatch
+                # stream, which is the shuffle's training-dynamics
+                # effect in runtimes (like localspark) whose
+                # repartition is only a partition-count hint.
+                rdd = base.rdd.repartition(n_parts)
+                if self.getOrDefault(self.useBarrier):
+                    rdd = rdd.barrier()  # torch_distributed.py:312-313
+                summaries.extend(
+                    rdd.mapPartitions(
+                        make_run_worker(round_idx * 100003)
+                    ).collect()
+                )
+                if server.should_stop:
+                    break
+            # Introspection hook for callers/tests (per-worker loss and
+            # observed-version traces).
+            self._last_hogwild_summaries = summaries
+            params, model_state = server.final_state()
+            import jax as _jax
+
+            params = _jax.device_get(params)
+            model_state = _jax.device_get(model_state)
+            return _encode_bundle(server.spec, params, model_state)
+        finally:
+            # Stop server even on failure (hogwild.py:184-186 parity).
+            http.stop()
+            server.stop()
+
     def _fit_barrier(self, dataset) -> str:
         """One barrier task per TPU host; rank = barrier partition id.
 
@@ -197,12 +354,6 @@ class SparkTorch(Estimator, _SparkTorchParams):
         the globally-sharded arrays with
         ``jax.make_array_from_process_local_data``).
         """
-        if self.getOrDefault(self.mode) in ("hogwild", "async"):
-            raise ValueError(
-                "deployMode='barrier' supports mode='synchronous' only; "
-                "run hogwild with deployMode='driver' (the parameter "
-                "server lives on the driver either way)"
-            )
         inp = self.getOrDefault(self.inputCol)
         label = (self.getOrDefault(self.labelCol)
                  if self.isDefined(self.labelCol) else None)
@@ -237,12 +388,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
             ctx = BarrierTaskContext.get()
             rank = ctx.partitionId()
             rows = list(iterator)
-            x = np.stack([
-                np.asarray(r[0], dtype=np.float32)
-                if not hasattr(r[0], "toArray")
-                else r[0].toArray().astype(np.float32)
-                for r in rows
-            ]) if rows else np.zeros((0, 1), np.float32)
+            x = _rows_to_x(rows) if rows else np.zeros((0, 1), np.float32)
             if label:
                 # Empty partitions still declare the label axis so the
                 # cross-host shape agreement holds (weight-0 padding
@@ -285,7 +431,14 @@ class SparkTorch(Estimator, _SparkTorchParams):
         return out[0]
 
 
-class SparkTorchModel(Model, _SparkTorchParams):
+from sparktorch_tpu.spark.pipeline_util import PythonStagePersistence
+
+
+class SparkTorchModel(Model, _SparkTorchParams, PythonStagePersistence):
+    """Fitted transformer. Persists inside standard Spark pipelines via
+    the carrier mechanism (PythonStagePersistence — the writer hook the
+    reference implements in ``pipeline_util.py:80-130``)."""
+
     modStr = Param(Params._dummy(), "modStr", "serialized trained model",
                    typeConverter=TypeConverters.toString)
 

@@ -336,13 +336,26 @@ def train_distributed_multihost(
     _MAX_RANK = 8
     if local_x.ndim - 1 > _MAX_RANK:
         raise ValueError(f"feature rank {local_x.ndim - 1} > {_MAX_RANK}")
-    shape_vec = np.full((2 + _MAX_RANK,), 0, np.int64)
+    # Layout: [rows, x_rank, x_dims(8), y_rank, y_dims(8)] — y_rank is
+    # -1 when this host has no labels, so donors can repair BOTH the
+    # feature and label shapes of an empty host.
+    width = 2 + _MAX_RANK + 1 + _MAX_RANK
+    shape_vec = np.full((width,), 0, np.int64)
     shape_vec[0] = local_x.shape[0]
     feat = local_x.shape[1:]
     shape_vec[1] = len(feat)
     shape_vec[2 : 2 + len(feat)] = feat
+    y_off = 2 + _MAX_RANK
+    if local_y is None:
+        shape_vec[y_off] = -1
+    else:
+        y_feat = local_y.shape[1:]
+        if len(y_feat) > _MAX_RANK:
+            raise ValueError(f"label rank {len(y_feat)} > {_MAX_RANK}")
+        shape_vec[y_off] = len(y_feat)
+        shape_vec[y_off + 1 : y_off + 1 + len(y_feat)] = y_feat
     gathered = multihost_utils.process_allgather(shape_vec)
-    gathered = gathered.reshape(-1, 2 + _MAX_RANK)
+    gathered = gathered.reshape(-1, width)
     counts = gathered[:, 0]
     if local_x.shape[0] == 0:
         donors = gathered[gathered[:, 0] > 0]
@@ -351,8 +364,12 @@ def train_distributed_multihost(
             feat = tuple(int(v) for v in donors[0, 2 : 2 + nd])
             local_x = np.zeros((0,) + feat, np.float32)
             if local_y is not None:
-                local_y = np.zeros((0,) + tuple(local_y.shape[1:]),
-                                   local_y.dtype)
+                y_rank = int(donors[0, y_off])
+                y_feat = (
+                    tuple(int(v) for v in donors[0, y_off + 1 : y_off + 1 + y_rank])
+                    if y_rank > 0 else ()
+                )
+                local_y = np.zeros((0,) + y_feat, local_y.dtype)
     # Unsupervised (y=x) aliasing AFTER the donor repair, so the empty
     # host's labels adopt the repaired feature shape too.
     if local_y is None:
