@@ -1,96 +1,11 @@
-"""Benchmark: MNIST-CNN synchronous training throughput on real TPU.
+"""Driver entry: prints ONE JSON line for the headline benchmark.
 
-North-star metric from BASELINE.json: examples/sec/chip (MNIST-CNN).
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
-compares against a measured reference-architecture proxy: the same
-workload run through torch (CPU, the reference's test substrate) would
-be orders slower; we report vs_baseline as the ratio to a fixed
-reference throughput recorded in REFERENCE_BASELINE below once
-measured, else 1.0.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The full five-config BASELINE.md suite lives in
+:mod:`sparktorch_tpu.bench` (``sparktorch-tpu-bench --config all``);
+raw logs are kept under ``benchmarks/`` per the BASELINE.md protocol.
 """
 
-from __future__ import annotations
-
-import json
-import time
-
-import numpy as np
-
-# Measured reference proxy (examples/sec) for the same MNIST-CNN
-# workload: torch-CPU forward+backward+Adam step, batch 1024, on this
-# machine — the substrate the reference's own tests/CI train on
-# (environment.yml pins CPU pytorch). Measured 2026-07-29 by
-# benchmarks/reference_proxy.py.
-REFERENCE_BASELINE_EXAMPLES_PER_SEC = 1120.8
-
-BATCH = 1024
-ITERS = 30
-WARMUP = 5
-
-
-def main() -> None:
-    import jax
-
-    from sparktorch_tpu.models import MnistCNN
-    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh, replicated
-    from sparktorch_tpu.train.step import create_train_state, make_train_epoch
-    from sparktorch_tpu.train.sync import prepare_sharded_batch
-    from sparktorch_tpu.utils.data import handle_features
-    from sparktorch_tpu.utils.serde import ModelSpec
-
-    devices = jax.devices()
-    n_chips = len(devices)
-    mesh = build_mesh(MeshConfig(), devices)
-
-    spec = ModelSpec(module=MnistCNN(), loss="cross_entropy",
-                     optimizer="adam", optimizer_params={"lr": 1e-3},
-                     input_shape=(784,))
-    rng = np.random.default_rng(0)
-    x = rng.normal(0, 1, (BATCH, 784)).astype(np.float32)
-    y = rng.integers(0, 10, (BATCH,)).astype(np.int32)
-    batch, _ = handle_features(x, y)
-    batch = prepare_sharded_batch(batch, mesh)
-
-    tx = spec.make_optimizer()
-    with mesh:
-        state = create_train_state(spec, jax.random.key(0),
-                                   sample_x=batch.x[:1], tx=tx)
-    state = jax.device_put(state, replicated(mesh))
-    # The whole measured run is ONE compiled call: ITERS steps fused by
-    # lax.scan — zero per-step Python/dispatch (the framework's fast
-    # path; the reference pays Python + per-param gloo per step).
-    epoch = make_train_epoch(spec.make_module().apply, spec.loss_fn(), tx,
-                             mesh, steps_per_call=ITERS)
-
-    import jax.numpy as jnp
-
-    for _ in range(WARMUP):
-        state, metrics = epoch(state, batch)
-    # float() forces full materialization — on the tunneled axon
-    # platform block_until_ready alone under-blocks.
-    float(jnp.sum(metrics.loss))
-
-    t0 = time.perf_counter()
-    state, metrics = epoch(state, batch)
-    float(jnp.sum(metrics.loss))
-    dt = time.perf_counter() - t0
-
-    examples_per_sec = BATCH * ITERS / dt
-    per_chip = examples_per_sec / n_chips
-    vs_baseline = (
-        per_chip / REFERENCE_BASELINE_EXAMPLES_PER_SEC
-        if REFERENCE_BASELINE_EXAMPLES_PER_SEC
-        else 1.0
-    )
-    print(json.dumps({
-        "metric": "examples/sec/chip (MNIST-CNN sync DP, batch 1024)",
-        "value": round(per_chip, 1),
-        "unit": "examples/sec/chip",
-        "vs_baseline": round(vs_baseline, 3),
-    }))
-
+from sparktorch_tpu.bench import main
 
 if __name__ == "__main__":
-    main()
+    main(["--config", "headline"])

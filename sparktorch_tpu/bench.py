@@ -1,0 +1,316 @@
+"""Benchmark suite: the five BASELINE.md configs on real TPU.
+
+The reference publishes no numbers (BASELINE.md), so these are the
+project's measured baselines. Configs (BASELINE.json):
+
+1. mnist_mlp_sync     — MNIST 3-layer MLP, synchronous DP
+2. lazy_cnn_sync      — MNIST CNN with LAZY model materialization
+3. resnet18_hogwild   — ResNet-18/CIFAR-10 shapes, async param server
+4. bert_dp            — BERT-base-shape encoder, sync DP (compute-bound)
+5. resnet50_inference — ResNet-50 batch inference (1M-row projection)
+
+Each bench returns a summary dict (examples/sec/chip + p50/p99 step
+times where steps exist) and appends raw per-phase records to a JSONL
+log (the protocol BASELINE.md prescribes: raw logs under
+``benchmarks/``).
+
+Timing: on the tunneled axon platform ``block_until_ready``
+under-blocks, so every measured region ends with a forced
+materialization (``float(jnp.sum(...))``).
+
+CLI: ``sparktorch-tpu-bench [--config all|headline|<name>] [--log PATH]``.
+``headline`` prints the single JSON line the benchmark driver consumes
+(same MNIST-CNN metric as round 1, for cross-round comparability).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+# Measured reference proxy (examples/sec) for the MNIST-CNN workload:
+# torch-CPU forward+backward+Adam, batch 1024, on this machine — the
+# substrate the reference's own tests/CI train on (environment.yml
+# pins CPU pytorch). Measured 2026-07-29 by benchmarks/reference_proxy.py.
+REFERENCE_BASELINE_EXAMPLES_PER_SEC = 1120.8
+
+
+def _materialize(*arrays) -> None:
+    import jax.numpy as jnp
+
+    for a in arrays:
+        float(jnp.sum(a)) if hasattr(a, "dtype") else None
+
+
+def _steps_summary(times: List[float]) -> Dict[str, float]:
+    ts = np.asarray(sorted(times))
+    return {
+        "step_time_p50_s": float(np.percentile(ts, 50)),
+        "step_time_p99_s": float(np.percentile(ts, 99)),
+        "step_time_mean_s": float(ts.mean()),
+    }
+
+
+def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
+                      warmup: int = 3, chunks: int = 3) -> dict:
+    """Shared harness for the sync-DP configs: whole chunks of steps
+    fused into one compiled call (the framework's fast path)."""
+    import jax
+
+    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh, replicated
+    from sparktorch_tpu.train.step import create_train_state, make_train_epoch
+    from sparktorch_tpu.train.sync import prepare_sharded_batch
+    from sparktorch_tpu.utils.data import handle_features
+
+    devices = jax.devices()
+    mesh = build_mesh(MeshConfig(), devices)
+    batch, _ = handle_features(x, y)
+    batch = prepare_sharded_batch(batch, mesh)
+    tx = spec.make_optimizer()
+    with mesh:
+        state = jax.jit(
+            lambda: create_train_state(spec, jax.random.key(0),
+                                       sample_x=batch.x[:1], tx=tx),
+            out_shardings=replicated(mesh),
+        )()
+    epoch = make_train_epoch(spec.make_module().apply, spec.loss_fn(), tx,
+                             mesh, steps_per_call=iters)
+    for _ in range(warmup):
+        state, metrics = epoch(state, batch)
+    _materialize(metrics.loss)
+
+    chunk_times = []
+    for _ in range(chunks):
+        t0 = time.perf_counter()
+        state, metrics = epoch(state, batch)
+        _materialize(metrics.loss)
+        chunk_times.append((time.perf_counter() - t0) / iters)
+    per_chip = batch_size / min(chunk_times) / len(devices)
+    return {
+        "examples_per_sec_per_chip": round(per_chip, 1),
+        "n_chips": len(devices),
+        "final_loss": float(np.asarray(metrics.loss)[-1]),
+        **_steps_summary(chunk_times),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Configs
+# ---------------------------------------------------------------------------
+
+
+def bench_mnist_mlp_sync() -> dict:
+    """BASELINE config 1 (examples/simple_dnn.py workload)."""
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    batch = 1024
+    x = rng.normal(0, 1, (batch, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,)).astype(np.int32)
+    spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3},
+                     input_shape=(784,))
+    out = _sync_epoch_bench(spec, x, y, batch)
+    return {"config": "mnist_mlp_sync", "unit": "examples/sec/chip", **out}
+
+
+def bench_mnist_cnn_sync() -> dict:
+    """The round-1 headline workload (examples/simple_cnn.py)."""
+    from sparktorch_tpu.models import MnistCNN
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    batch = 1024
+    x = rng.normal(0, 1, (batch, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,)).astype(np.int32)
+    spec = ModelSpec(module=MnistCNN(), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3},
+                     input_shape=(784,))
+    out = _sync_epoch_bench(spec, x, y, batch)
+    return {"config": "mnist_cnn_sync", "unit": "examples/sec/chip", **out}
+
+
+def bench_lazy_cnn_sync() -> dict:
+    """BASELINE config 2: the LAZY serialization path — the model
+    class ships unmaterialized and is first instantiated here
+    (examples/lazy_load_cnn.py; reference util.py:148-179)."""
+    from sparktorch_tpu.models import MnistCNN
+    from sparktorch_tpu.utils.serde import deserialize_model, serialize_model_lazy
+
+    payload = serialize_model_lazy(
+        MnistCNN, criterion="cross_entropy", optimizer="adam",
+        optimizer_params={"lr": 1e-3}, input_shape=(784,),
+    )
+    t0 = time.perf_counter()
+    spec = deserialize_model(payload)
+    lazy_materialize_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    batch = 1024
+    x = rng.normal(0, 1, (batch, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,)).astype(np.int32)
+    out = _sync_epoch_bench(spec, x, y, batch)
+    return {"config": "lazy_cnn_sync", "unit": "examples/sec/chip",
+            "lazy_materialize_s": round(lazy_materialize_s, 4), **out}
+
+
+def bench_resnet18_hogwild() -> dict:
+    """BASELINE config 3: ResNet-18 on CIFAR-10 shapes through the
+    async param server (device-pinned workers, versioned pulls)."""
+    import jax
+
+    from sparktorch_tpu.models.resnet import resnet18
+    from sparktorch_tpu.train.hogwild import train_async
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    n, mb = 2048, 256
+    x = rng.normal(0, 1, (n, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, (n,)).astype(np.int32)
+    spec = ModelSpec(module=resnet18(num_classes=10), loss="cross_entropy",
+                     optimizer="sgd", optimizer_params={"lr": 1e-2},
+                     input_shape=(32, 32, 3))
+    iters = 20
+    # Warmup run compiles the grad step + server apply.
+    train_async(spec, x[:mb], labels=y[:mb], iters=2, mini_batch=mb)
+    t0 = time.perf_counter()
+    result = train_async(spec, x, labels=y, iters=iters, mini_batch=mb)
+    dt = time.perf_counter() - t0
+    n_workers = len(jax.devices())
+    pushes = len(result.metrics)
+    per_chip = pushes * mb / dt / n_workers
+    times = [dt / max(1, pushes)] * pushes
+    return {
+        "config": "resnet18_hogwild", "unit": "examples/sec/chip",
+        "examples_per_sec_per_chip": round(per_chip, 1),
+        "n_chips": n_workers, "pushes": pushes,
+        "final_loss": result.metrics[-1]["loss"],
+        **_steps_summary(times),
+    }
+
+
+def bench_bert_dp() -> dict:
+    """BASELINE config 4: BERT-base-shape encoder fine-tune step,
+    sync DP — the compute-bound all-reduce stress config. Reports an
+    approximate MFU against the 6*N*T transformer-FLOPs rule."""
+    import jax
+
+    from sparktorch_tpu.models.transformer import bert_base
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    batch, seq = 32, 128
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 30522, (batch, seq)).astype(np.int32)
+    y = rng.integers(0, 2, (batch,)).astype(np.int32)
+    module = bert_base()
+    spec = ModelSpec(module=module, loss="cross_entropy", optimizer="adam",
+                     optimizer_params={"lr": 2e-5}, input_shape=(seq,))
+    out = _sync_epoch_bench(spec, x, y, batch, iters=10, warmup=2, chunks=3)
+
+    n_params = sum(
+        int(np.prod(p.shape))
+        for p in jax.tree.leaves(
+            module.init(jax.random.key(0),
+                        np.zeros((1, seq), np.int32))["params"]
+        )
+    )
+    tokens_per_step = batch * seq
+    flops_per_step = 6 * n_params * tokens_per_step  # fwd+bwd rule
+    steps_per_sec = out["examples_per_sec_per_chip"] * out["n_chips"] / batch
+    achieved_tflops = flops_per_step * steps_per_sec / out["n_chips"] / 1e12
+    return {
+        "config": "bert_dp", "unit": "examples/sec/chip",
+        "n_params": n_params,
+        "achieved_tflops_per_chip": round(achieved_tflops, 2),
+        **out,
+    }
+
+
+def bench_resnet50_inference() -> dict:
+    """BASELINE config 5: ResNet-50 batch inference through
+    BatchPredictor (the partition-parallel inference path); reports
+    measured examples/sec/chip and the projected wall-clock for the
+    1M-row workload the config names."""
+    import jax
+
+    from sparktorch_tpu.inference import BatchPredictor
+    from sparktorch_tpu.models.resnet import resnet50
+
+    module = resnet50()
+    rng = np.random.default_rng(0)
+    chunk = 256
+    variables = module.init(jax.random.key(0),
+                            np.zeros((1, 224, 224, 3), np.float32))
+    predictor = BatchPredictor(module, variables["params"],
+                               {k: v for k, v in variables.items()
+                                if k != "params"}, chunk=chunk)
+    x = rng.normal(0, 1, (chunk * 4, 224, 224, 3)).astype(np.float32)
+    predictor.predict(x[:chunk])  # compile
+    t0 = time.perf_counter()
+    out = predictor.predict(x)
+    assert out.shape[0] == x.shape[0]
+    dt = time.perf_counter() - t0
+    n_chips = len(jax.devices())
+    per_chip = x.shape[0] / dt / n_chips
+    return {
+        "config": "resnet50_inference", "unit": "examples/sec/chip",
+        "examples_per_sec_per_chip": round(per_chip, 1),
+        "n_chips": n_chips,
+        "projected_1M_rows_s": round(1_000_000 / (per_chip * n_chips), 1),
+    }
+
+
+CONFIGS: Dict[str, Callable[[], dict]] = {
+    "mnist_mlp_sync": bench_mnist_mlp_sync,
+    "mnist_cnn_sync": bench_mnist_cnn_sync,
+    "lazy_cnn_sync": bench_lazy_cnn_sync,
+    "resnet18_hogwild": bench_resnet18_hogwild,
+    "bert_dp": bench_bert_dp,
+    "resnet50_inference": bench_resnet50_inference,
+}
+
+
+def _headline() -> dict:
+    """The driver's ONE-JSON-line metric — same workload as round 1."""
+    out = bench_mnist_cnn_sync()
+    per_chip = out["examples_per_sec_per_chip"]
+    return {
+        "metric": "examples/sec/chip (MNIST-CNN sync DP, batch 1024)",
+        "value": per_chip,
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_BASELINE_EXAMPLES_PER_SEC, 3),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="sparktorch-tpu-bench")
+    parser.add_argument("--config", default="headline",
+                        choices=["headline", "all", *CONFIGS])
+    parser.add_argument("--log", default=None,
+                        help="append raw result records to this JSONL file")
+    args = parser.parse_args(argv)
+
+    if args.config == "headline":
+        print(json.dumps(_headline()))
+        return
+
+    names = list(CONFIGS) if args.config == "all" else [args.config]
+    records = []
+    for name in names:
+        rec = CONFIGS[name]()
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        records.append(rec)
+        print(json.dumps(rec))
+    if args.log:
+        with open(args.log, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,12 +30,6 @@ from sparktorch_tpu.ml.dataset import LocalDataFrame
 N_DEVICES = 8
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: long-running multi-process executor tests"
-    )
-
-
 @pytest.fixture(scope="session", autouse=True)
 def _assert_world():
     assert len(jax.devices()) == N_DEVICES, (
