@@ -97,6 +97,9 @@ class GangWorker:
                  timeout_ms: int = 30_000, heartbeat_interval_s: float = 2.0):
         self._lib = _lib()
         self.rank = rank
+        # Kept for heartbeat-socket reconnection (re-REG is idempotent
+        # server-side: members[rank] is overwritten, gang.cpp:104-110).
+        self._endpoint = (host, port, address, timeout_ms)
         self._handle = self._lib.gang_client_connect(
             host.encode(), port, rank, address.encode(), timeout_ms
         )
@@ -116,15 +119,48 @@ class GangWorker:
         )
         self._hb_thread.start()
 
+    # Consecutive socket-level heartbeat failures tolerated before the
+    # gang is considered lost. A DEAD reply from the coordinator (rc=1)
+    # is authoritative and fires immediately; rc=-1 is a local I/O
+    # error (TCP hiccup, slow coordinator) and must not kill a healthy
+    # run — especially now that check_gang() polls every chunk.
+    _HB_MAX_IO_FAILURES = 3
+
     def _heartbeat_loop(self, interval: float):
+        io_failures = 0
         while not self._hb_stop.wait(interval):
             with self._hb_lock:
                 if self._hb_handle is None:
                     return
                 rc = self._lib.gang_client_heartbeat(self._hb_handle)
-            if rc != 0:
+            if rc == 0:
+                io_failures = 0
+            elif rc > 0:  # coordinator replied DEAD: authoritative
                 self._hb_dead.set()
                 return
+            else:
+                io_failures += 1
+                if io_failures >= self._HB_MAX_IO_FAILURES:
+                    self._hb_dead.set()
+                    return
+                # A failed fd stays failed: reconnect before retrying.
+                # Dial OUTSIDE the lock (close() must never wait on a
+                # connect) and with a short timeout — this is a quick
+                # probe, not first registration; a failed dial just
+                # spends one of the remaining strikes.
+                host, port, address, timeout_ms = self._endpoint
+                fresh = self._lib.gang_client_connect(
+                    host.encode(), port, self.rank,
+                    address.encode(), min(timeout_ms, 2000),
+                ) or None
+                with self._hb_lock:
+                    if self._hb_handle is None:  # close()d meanwhile
+                        if fresh:
+                            self._lib.gang_client_close(fresh)
+                        return
+                    if fresh:
+                        self._lib.gang_client_close(self._hb_handle)
+                        self._hb_handle = fresh
 
     def barrier(self, epoch: int) -> None:
         """Gang entry point — the analog of all barrier tasks reaching
@@ -134,6 +170,24 @@ class GangWorker:
         rc = self._lib.gang_client_barrier(self._handle, epoch)
         if rc != 0:
             raise GangFailure(f"barrier {epoch} failed (rc={rc})")
+
+    @property
+    def failed(self) -> bool:
+        """True once the coordinator has declared ANY member dead (the
+        heartbeat reply flips to DEAD gang-wide, so survivors learn of
+        a peer's death within one heartbeat interval)."""
+        return self._hb_dead.is_set()
+
+    def check(self) -> None:
+        """Raise :class:`GangFailure` if the gang has failed. Cheap
+        (reads a local event set by the heartbeat thread) — call it
+        from host-side training loops between compiled steps so a dead
+        host aborts the survivors promptly instead of letting them
+        wedge in the next XLA collective."""
+        if self.failed:
+            raise GangFailure(
+                f"rank {self.rank}: gang failed (peer declared dead)"
+            )
 
     def world(self) -> List[str]:
         buf = ctypes.create_string_buffer(1 << 16)
@@ -146,6 +200,10 @@ class GangWorker:
         """Test hook: silence this member so the coordinator's failure
         detector fires."""
         self._hb_stop.set()
+
+    @property
+    def closed(self) -> bool:
+        return self._handle is None
 
     def close(self):
         self._hb_stop.set()

@@ -30,6 +30,33 @@ import jax
 DEFAULT_JAX_COORD_PORT = 8476
 DEFAULT_GANG_PORT = 8475
 
+# The gang worker for THIS process's current multi-host run, if any.
+# Training loops poll it via check_gang() between compiled steps so a
+# dead peer raises GangFailure on the survivors promptly instead of
+# wedging them in the next collective.
+_ACTIVE_WORKER = None
+
+
+def register_gang_worker(worker) -> None:
+    global _ACTIVE_WORKER
+    _ACTIVE_WORKER = worker
+
+
+def check_gang() -> None:
+    """Raise GangFailure if this process's gang has failed; no-op when
+    no multi-host gang is active (the common single-host case). A
+    worker that has been close()d is dropped from the registry here,
+    so a later (e.g. retried single-host) training in the same process
+    doesn't trip over a stale dead gang."""
+    global _ACTIVE_WORKER
+    worker = _ACTIVE_WORKER
+    if worker is None:
+        return
+    if worker.closed:
+        _ACTIVE_WORKER = None
+        return
+    worker.check()
+
 
 def _local_ip() -> str:
     # SPARK_LOCAL_IP is honored for drop-in parity with the
@@ -89,4 +116,5 @@ def bringup_multihost(
         num_processes=world_size,
         process_id=rank,
     )
+    register_gang_worker(worker)
     return coord, worker

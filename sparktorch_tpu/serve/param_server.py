@@ -75,8 +75,14 @@ class ParameterServer:
         self._opt_state = jax.device_put(self._tx.init(params), self.device)
         self.slot = VersionedSlot(params)
 
-        # One compiled apply for the life of the server.
+        # One compiled apply for the life of the server. Grads arrive
+        # in whatever dtype the wire used (bf16 from HttpTransport's
+        # compressed pushes); cast up to the param dtype before the
+        # optimizer update so moments stay full precision.
         def _apply(params, opt_state, grads):
+            grads = jax.tree.map(
+                lambda g, p: g.astype(p.dtype), grads, params
+            )
             updates, new_opt = self._tx.update(grads, opt_state, params)
             import optax
 

@@ -420,7 +420,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
                     )
             finally:
                 if worker is not None:
-                    worker.close()
+                    worker.close()  # also unregisters the liveness check
 
         try:
             out = rdd.barrier().mapPartitions(run_host).collect()

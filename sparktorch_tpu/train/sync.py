@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from sparktorch_tpu.parallel.launch import check_gang
 from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, build_mesh, replicated
 from sparktorch_tpu.train.step import (
     TrainState,
@@ -205,93 +206,109 @@ def train_distributed(
     shuffle_key = jax.random.key(seed + 1)
     profiler = profile_run(profile_dir)
     profiler.__enter__()
-    for shuffle_round in range(max(1, partition_shuffles)):
-        if shuffle_round > 0:
-            shuffle_key, sub = jax.random.split(shuffle_key)
-            train_batch = _shuffle_batch(train_batch, sub, mesh)
-        stop = False
-        i = 0
-        while i < iters:
-            t0 = time.perf_counter()
-            if steps_per_call > 1:
-                n = min(steps_per_call, iters - i)
-                with step_annotation(int(metrics[-1]["iter"]) + 1 if metrics else 0):
-                    state, stacked = train_step(state, train_batch)
-                losses = np.asarray(stacked.loss)[:n]
-                examples = np.asarray(stacked.examples)[:n]
-                gnorms = np.asarray(stacked.grad_norm)[:n]
-                dt = (time.perf_counter() - t0) / n
-                chunk = [
-                    (float(l), float(e), float(g))
-                    for l, e, g in zip(losses, examples, gnorms)
-                ]
-            else:
-                with step_annotation(i):
-                    state, step_metrics = train_step(state, train_batch)
-                chunk = [(
-                    float(step_metrics.loss),
-                    float(step_metrics.examples),
-                    float(step_metrics.grad_norm),
-                )]
-                dt = time.perf_counter() - t0
+    completed = False
+    try:
+        for shuffle_round in range(max(1, partition_shuffles)):
+            if shuffle_round > 0:
+                shuffle_key, sub = jax.random.split(shuffle_key)
+                train_batch = _shuffle_batch(train_batch, sub, mesh)
+            stop = False
+            i = 0
+            while i < iters:
+                # Fail fast if a peer host died (multi-host runs only; the
+                # gang's heartbeat marks survivors dead within one
+                # interval). Checking here — before dispatching the next
+                # compiled chunk — means we raise GangFailure instead of
+                # wedging in the chunk's collectives.
+                check_gang()
+                t0 = time.perf_counter()
+                if steps_per_call > 1:
+                    n = min(steps_per_call, iters - i)
+                    with step_annotation(int(metrics[-1]["iter"]) + 1 if metrics else 0):
+                        state, stacked = train_step(state, train_batch)
+                    losses = np.asarray(stacked.loss)[:n]
+                    examples = np.asarray(stacked.examples)[:n]
+                    gnorms = np.asarray(stacked.grad_norm)[:n]
+                    dt = (time.perf_counter() - t0) / n
+                    chunk = [
+                        (float(l), float(e), float(g))
+                        for l, e, g in zip(losses, examples, gnorms)
+                    ]
+                else:
+                    with step_annotation(i):
+                        state, step_metrics = train_step(state, train_batch)
+                    chunk = [(
+                        float(step_metrics.loss),
+                        float(step_metrics.examples),
+                        float(step_metrics.grad_norm),
+                    )]
+                    dt = time.perf_counter() - t0
 
-            for loss, examples_n, gnorm in chunk:
-                val_loss = (
-                    float(eval_step(state, val_batch))
-                    if eval_step is not None and steps_per_call == 1
-                    else None
-                )
-                record = {
-                    "round": shuffle_round,
-                    "iter": i,
-                    "loss": loss,
-                    "val_loss": val_loss,
-                    "examples": examples_n,
-                    "grad_norm": gnorm,
-                    "step_time_s": dt,
-                }
-                recorder.record(record)
-                if metrics_hook:
-                    metrics_hook(record)
-                if verbose:
-                    # Reference prints per-partition loss lines
-                    # (distributed.py:201-204); here one global line.
-                    msg = f"[sparktorch_tpu] round {shuffle_round} iter {i} loss {loss:.6f}"
-                    if val_loss is not None:
-                        msg += f" val_loss {val_loss:.6f}"
-                    print(msg)
-                # Early stop needs no collective: `loss` is already the
-                # global mean, identical on every host (vs the
-                # reference's two extra all_reduces,
-                # distributed.py:186-197).
-                if stopper is not None:
-                    signal = val_loss if val_loss is not None else loss
-                    if stopper.step(signal):
-                        stop = True
-                        break
-                i += 1
-            if ckpt is not None and checkpoint_every > 0:
-                # Save on the first chunk boundary at or past the
-                # cadence — a fused chunk that strides over the exact
-                # multiple must not silently skip the save.
-                step_now = int(jax.device_get(state.step))
-                if step_now - last_ckpt_step >= checkpoint_every:
-                    ckpt.save(step_now, state)
-                    last_ckpt_step = step_now
+                for loss, examples_n, gnorm in chunk:
+                    val_loss = (
+                        float(eval_step(state, val_batch))
+                        if eval_step is not None and steps_per_call == 1
+                        else None
+                    )
+                    record = {
+                        "round": shuffle_round,
+                        "iter": i,
+                        "loss": loss,
+                        "val_loss": val_loss,
+                        "examples": examples_n,
+                        "grad_norm": gnorm,
+                        "step_time_s": dt,
+                    }
+                    recorder.record(record)
+                    if metrics_hook:
+                        metrics_hook(record)
+                    if verbose:
+                        # Reference prints per-partition loss lines
+                        # (distributed.py:201-204); here one global line.
+                        msg = f"[sparktorch_tpu] round {shuffle_round} iter {i} loss {loss:.6f}"
+                        if val_loss is not None:
+                            msg += f" val_loss {val_loss:.6f}"
+                        print(msg)
+                    # Early stop needs no collective: `loss` is already the
+                    # global mean, identical on every host (vs the
+                    # reference's two extra all_reduces,
+                    # distributed.py:186-197).
+                    if stopper is not None:
+                        signal = val_loss if val_loss is not None else loss
+                        if stopper.step(signal):
+                            stop = True
+                            break
+                    i += 1
+                if ckpt is not None and checkpoint_every > 0:
+                    # Save on the first chunk boundary at or past the
+                    # cadence — a fused chunk that strides over the exact
+                    # multiple must not silently skip the save.
+                    step_now = int(jax.device_get(state.step))
+                    if step_now - last_ckpt_step >= checkpoint_every:
+                        ckpt.save(step_now, state)
+                        last_ckpt_step = step_now
+                if stop:
+                    break
             if stop:
                 break
-        if stop:
-            break
-
-    profiler.__exit__(None, None, None)
-    if ckpt is not None:
-        # Final snapshot at the end of training (unless the periodic
-        # save already captured this exact step).
-        final_step = int(jax.device_get(state.step))
-        if ckpt.latest_step() != final_step:
-            ckpt.save(final_step, state, force=True)
-        ckpt.wait()
-        ckpt.close()
+        completed = True
+    finally:
+        # Cleanup must run on the failure paths too (GangFailure from
+        # check_gang, a raising metrics_hook): close the profiler
+        # trace and flush async checkpoint writes already in flight.
+        # The FINAL snapshot fires only on clean completion — orbax
+        # saves are cross-process collectives, so attempting one after
+        # a peer died would wedge the survivor in exactly the hang
+        # check_gang() exists to prevent (periodic saves from the loop
+        # above are still on disk for resume).
+        profiler.__exit__(None, None, None)
+        if ckpt is not None:
+            if completed:
+                final_step = int(jax.device_get(state.step))
+                if ckpt.latest_step() != final_step:
+                    ckpt.save(final_step, state, force=True)
+            ckpt.wait()
+            ckpt.close()
 
     params = jax.device_get(state.params)
     model_state = jax.device_get(state.model_state)

@@ -192,9 +192,9 @@ def test_inference_roundtrip(data, lazy_model):
     np.testing.assert_allclose(p1, p2, rtol=1e-6)
 
 
-def test_hogwild_mode_not_yet(data, general_model):
-    # Async mode is dispatched through the same estimator; covered in
-    # test_hogwild.py once the param server lands.
+def test_invalid_mode_rejected(data, general_model):
+    # Unknown mode strings must fail fast at fit() time. (The valid
+    # async path itself is covered in test_hogwild.py.)
     est = SparkTorch(
         inputCol="features", labelCol="label", torchObj=general_model,
         iters=2, mode="definitely_not_a_mode",

@@ -139,3 +139,54 @@ def test_http_transport_liveness_and_stop(payload):
     finally:
         http.stop()
         server.stop()
+
+
+def test_hogwild_http_bf16_compressed_push(payload):
+    # The HTTP wire ships bf16 gradients by default (half the bytes of
+    # the reference's full-precision push); training must still learn.
+    x, y = _blob_data()
+    result = train_async(payload, x, labels=y, iters=25, partitions=2,
+                         mini_batch=32, transport="http", port=0, seed=0)
+    import jax
+    import jax.numpy as jnp
+
+    spec = deserialize_model(payload)
+    module = spec.make_module()
+    init_vars = spec.init_params(jax.random.key(0))
+
+    def full_loss(variables):
+        preds = module.apply(variables, jnp.asarray(x))
+        return float(jnp.mean((preds[:, 0] - jnp.asarray(y)) ** 2))
+
+    assert full_loss({"params": result.params}) < full_loss(init_vars) * 0.8
+
+
+def test_hogwild_push_every_accumulates(payload, monkeypatch):
+    # push_every=k accumulates k minibatch grads on-device and pushes
+    # their mean: k-fold fewer server applies, same examples seen.
+    from sparktorch_tpu.train import hogwild as hw
+
+    pushes = []
+    real_push = hw.LocalTransport.push
+    monkeypatch.setattr(
+        hw.LocalTransport, "push",
+        lambda self, grads: (pushes.append(1), real_push(self, grads))[1],
+    )
+    x, y = _blob_data()
+    result = train_async(payload, x, labels=y, iters=24, partitions=2,
+                         mini_batch=32, push_every=4, seed=0)
+    # 2 workers x 24 iters / 4 = 12 pushes; worker records still 48.
+    assert len(pushes) == 12
+    assert len(result.metrics) == 48
+    import jax
+    import jax.numpy as jnp
+
+    spec = deserialize_model(payload)
+    module = spec.make_module()
+    init_vars = spec.init_params(jax.random.key(0))
+
+    def full_loss(variables):
+        preds = module.apply(variables, jnp.asarray(x))
+        return float(jnp.mean((preds[:, 0] - jnp.asarray(y)) ** 2))
+
+    assert full_loss({"params": result.params}) < full_loss(init_vars) * 0.8

@@ -215,3 +215,53 @@ def test_gang_stop_releases_barrier_with_error():
     assert not t.is_alive()
     assert err, "expected GangFailure on shutdown-released barrier"
     w0.close()
+
+
+def test_trainer_aborts_when_peer_host_dies():
+    # Trainer-level failure path: a multi-host run where a PEER host
+    # dies mid-training. The survivor's training loop polls the gang
+    # via launch.check_gang() between compiled chunks and must raise
+    # GangFailure promptly instead of wedging in the next collective.
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.parallel import launch
+    from sparktorch_tpu.train.sync import train_distributed
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    with GangCoordinator(world_size=2, heartbeat_timeout_ms=400) as coord:
+        survivor = GangWorker("127.0.0.1", coord.port, 0, "a:1",
+                              heartbeat_interval_s=0.1)
+        peer = GangWorker("127.0.0.1", coord.port, 1, "b:1",
+                          heartbeat_interval_s=0.1)
+        launch.register_gang_worker(survivor)
+        try:
+            rng = np.random.default_rng(0)
+            x = rng.normal(0, 1, (64, 784)).astype(np.float32)
+            y = rng.integers(0, 10, (64,)).astype(np.int32)
+            spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                             optimizer="sgd", optimizer_params={"lr": 1e-2},
+                             input_shape=(784,))
+
+            killed = threading.Event()
+
+            def hook(record):
+                # Kill the peer after the first recorded step, then
+                # pace the loop so detection latency (~0.5s: timeout
+                # 400ms + one heartbeat interval) always lands well
+                # before the iteration budget runs out, however fast
+                # the per-step compile turns out to be.
+                if not killed.is_set():
+                    peer.suspend_heartbeat()
+                    killed.set()
+                time.sleep(0.01)
+
+            t0 = time.perf_counter()
+            with pytest.raises(GangFailure):
+                train_distributed(spec, x, labels=y, iters=100_000,
+                                  steps_per_call=1, metrics_hook=hook)
+            assert killed.is_set()
+            # "Promptly": a tiny fraction of what 100k steps need.
+            assert time.perf_counter() - t0 < 60
+        finally:
+            launch.register_gang_worker(None)
+            survivor.close()
+            peer.close()
