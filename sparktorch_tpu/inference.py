@@ -72,34 +72,71 @@ class BatchPredictor:
             self._fwd = jax.jit(fwd)
             self._x_sharding = None
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        n = x.shape[0]
-        if n == 0:
-            # Probe one padded shard-batch for the output shape.
-            probe = np.zeros((self._n_shards, *x.shape[1:]), x.dtype)
-            arr = jnp.asarray(probe)
-            if self._x_sharding is not None:
-                arr = jax.device_put(arr, self._x_sharding)
-            out = np.asarray(self._fwd(self._params, self._model_state, arr))
-            return out[:0]
-        outs = []
+    def _chunks(self, x, n: int):
+        """Yield (padded_part, real_rows) chunks of ONE compiled shape
+        (the last small chunk pads only to shard divisibility)."""
         ns = self._n_shards
         for start in range(0, n, self.chunk):
             part = x[start : start + self.chunk]
             real = part.shape[0]
             if real < self.chunk:
-                # Steady-state calls keep ONE compiled shape; a single
-                # small call pads only to shard divisibility.
-                target = self.chunk if n > self.chunk else ((real + ns - 1) // ns) * ns
+                target = (
+                    self.chunk if n > self.chunk
+                    else ((real + ns - 1) // ns) * ns
+                )
                 if target != real:
-                    pad = np.zeros((target - real, *part.shape[1:]), part.dtype)
-                    part = np.concatenate([part, pad])
-            arr = jnp.asarray(part)
-            if self._x_sharding is not None:
-                arr = jax.device_put(arr, self._x_sharding)
-            out = np.asarray(self._fwd(self._params, self._model_state, arr))
-            outs.append(out[:real])
-        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+                    if isinstance(part, np.ndarray):
+                        pad = np.zeros((target - real, *part.shape[1:]),
+                                       part.dtype)
+                        part = np.concatenate([part, pad])
+                    else:  # device-resident input pads on-device
+                        pad = jnp.zeros((target - real, *part.shape[1:]),
+                                        part.dtype)
+                        part = jnp.concatenate([part, pad])
+            yield part, real
+
+    def _put(self, part):
+        arr = jnp.asarray(part)
+        if self._x_sharding is not None:
+            arr = jax.device_put(arr, self._x_sharding)
+        return arr
+
+    def predict(self, x) -> np.ndarray:
+        """Chunked forward over ``x`` (numpy or an already-device-
+        resident jax array — the latter skips host transfers).
+
+        The loop is double-buffered: chunk i+1's host→device copy is
+        enqueued and chunk i+1's forward dispatched BEFORE chunk i's
+        result is read back, so the (blocking) readback of one chunk
+        overlaps the transfer+compute of the next (JAX dispatch is
+        async). Device memory stays O(2 chunks) — outputs are drained
+        as the loop advances, never accumulated on device (a 1M-row
+        run would otherwise hold the full logits array in HBM).
+        """
+        n = x.shape[0]
+        if n == 0:
+            # Probe one padded shard-batch for the output shape.
+            probe = np.zeros((self._n_shards, *x.shape[1:]), x.dtype)
+            out = np.asarray(
+                self._fwd(self._params, self._model_state, self._put(probe))
+            )
+            return out[:0]
+        parts = self._chunks(x, n)
+        host = []
+        nxt = next(parts, None)
+        dev = self._put(nxt[0]) if nxt else None
+        prev = None  # (device_out, real) one chunk behind
+        while nxt is not None:
+            _, real = nxt
+            out = self._fwd(self._params, self._model_state, dev)
+            nxt = next(parts, None)
+            if nxt is not None:
+                dev = self._put(nxt[0])  # overlaps with the fwd above
+            if prev is not None:
+                host.append(np.asarray(prev[0])[: prev[1]])
+            prev = (out, real)
+        host.append(np.asarray(prev[0])[: prev[1]])
+        return np.concatenate(host) if len(host) > 1 else host[0]
 
     def predict_stream(self, batches: Iterable[np.ndarray]) -> Iterator[np.ndarray]:
         """Partition-parallel streaming inference: feed numpy batches

