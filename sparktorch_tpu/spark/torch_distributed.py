@@ -282,6 +282,16 @@ class SparkTorch(Estimator, _SparkTorchParams):
                 if w_spec.input_shape is None:
                     w_spec.input_shape = tuple(x.shape[1:])
                 y = _labels_to_f32([r[1] for r in rows], label) if label else x
+                if mini_batch and mini_batch > 0:
+                    # Block minibatch sampling (sample_minibatch)
+                    # requires random resident order; a label-sorted
+                    # partition would otherwise feed single-class
+                    # blocks all run. handle_features only permutes
+                    # when validation_pct > 0, so shuffle here.
+                    perm = np.random.default_rng(round_seed).permutation(
+                        x.shape[0]
+                    )
+                    x, y = x[perm], y[perm]
                 # Per-partition validation split, like the reference's
                 # executor-side handle_features (util.py:57-100).
                 shard, val_shard = handle_features(

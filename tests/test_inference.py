@@ -65,3 +65,20 @@ def test_fitted_model_set_mesh(data):
     p1 = [float(r["predictions"]) for r in res_plain.collect()]
     p2 = [float(r["predictions"]) for r in res_mesh.collect()]
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_predictor_device_input_parity():
+    # Device-resident input must skip host transfers and match the
+    # numpy path bit-for-bit (incl. the ragged last chunk).
+    import jax
+    import jax.numpy as jnp
+
+    from sparktorch_tpu.models import MnistMLP
+
+    module = MnistMLP()
+    variables = module.init(jax.random.key(0), np.zeros((1, 784), np.float32))
+    pred = BatchPredictor(module, variables["params"], {}, chunk=64)
+    x = np.random.default_rng(0).normal(0, 1, (200, 784)).astype(np.float32)
+    np.testing.assert_allclose(
+        pred.predict(x), np.asarray(pred.predict(jnp.asarray(x))), rtol=1e-6
+    )
