@@ -1,0 +1,31 @@
+# Developer entry points (reference parity: Makefile:1-15 exposes
+# docker build/run-test; here the runtime is local JAX + the native
+# C++ components, built on demand by tests).
+
+PYTHON ?= python
+
+.PHONY: install test test-fast native bench bench-all clean
+
+install:
+	$(PYTHON) -m pip install -e .
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+# Build the native C++ runtime (gang coordinator, rowpack parser)
+# explicitly; tests otherwise build it on first use.
+native:
+	$(PYTHON) -c "from sparktorch_tpu.native.build import load_library; \
+	load_library('gang'); load_library('rowpack'); print('native OK')"
+
+bench:
+	$(PYTHON) bench.py
+
+bench-all:
+	$(PYTHON) -m sparktorch_tpu.bench --config all --log benchmarks/bench_local.jsonl
+
+clean:
+	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

@@ -227,7 +227,7 @@ def bench_bert_dp() -> dict:
     from sparktorch_tpu.models.transformer import bert_base
     from sparktorch_tpu.utils.serde import ModelSpec
 
-    batch, seq = 32, 128
+    batch, seq = 128, 128  # batch swept 32/64/128: MXU util peaks here
     rng = np.random.default_rng(0)
     x = rng.integers(0, 30522, (batch, seq)).astype(np.int32)
     y = rng.integers(0, 2, (batch,)).astype(np.int32)

@@ -51,6 +51,18 @@ class TransformerConfig:
     attn_impl: str = "dense"  # 'dense' | 'ring'
     causal: bool = False
     remat: bool = False
+    # Mixture-of-experts (0 = dense FFN everywhere). Expert weights
+    # carry a leading experts dim that the sharding rules lay out over
+    # the ``ep`` mesh axis; GSPMD then derives the dispatch/combine
+    # all-to-alls from the einsum operand shardings.
+    n_experts: int = 0
+    moe_every: int = 2          # every k-th layer uses the MoE FFN
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2  # switch-style load-balance loss
+    # Routing group size: tokens route within fixed-size groups, so
+    # the dispatch/combine one-hots are O(n * group * cf) elements —
+    # linear in total tokens — instead of O(n^2) with global routing.
+    moe_group_size: int = 4096
 
     @property
     def head_dim(self) -> int:
@@ -97,8 +109,99 @@ class MultiHeadAttention(nn.Module):
         )(out)
 
 
+class MoEFFN(nn.Module):
+    """Switch-style top-1 mixture-of-experts FFN.
+
+    No reference counterpart (SURVEY §2.4: EP "absent"). TPU-first
+    design: routing, dispatch, expert matmuls and combine are four
+    einsums over a (experts, capacity, d_model) layout — no per-expert
+    Python, no dynamic shapes. Expert weights have a leading experts
+    dim that the sharding rules place on the ``ep`` mesh axis; under
+    GSPMD the dispatch einsum's operands (tokens sharded over dp,
+    experts sharded over ep) force the all-to-all, and the combine
+    reverses it. The switch load-balance loss is sown (pre-weighted by
+    ``moe_aux_weight``) into the ``losses`` collection; the sharded
+    trainer adds every sown loss to the objective.
+
+    Tokens route within fixed-size groups (``moe_group_size``), so the
+    dispatch/combine one-hots stay linear in total tokens. Known
+    limitation: weight-0 padding rows (the empty-partition protocol)
+    still participate in routing and the aux loss — the module never
+    sees per-example weights. Shard-divisibility padding adds fewer
+    than n_batch_shards rows, so keep padding fractions small relative
+    to the batch.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        import math
+
+        cfg = self.config
+        dt = cfg.compute_dtype
+        b, s, d = x.shape
+        e = cfg.n_experts
+        n = b * s
+        # Largest group size <= moe_group_size dividing n (n and the
+        # bound are trace-time ints, so this loop is free).
+        g = min(n, max(1, cfg.moe_group_size))
+        while n % g:
+            g -= 1
+        n_groups = n // g
+        tokens = x.reshape(n_groups, g, d)
+        # Static per-group capacity: ceil(capacity_factor * g / e).
+        cap = max(1, math.ceil(cfg.capacity_factor * g / e))
+
+        # Router in f32 (small matmul; numerics matter more than MXU).
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )                                            # (G, g, e)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)               # (G, g)
+        choice = jnp.argmax(probs, axis=-1)          # (G, g)
+
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)   # (G, g, e)
+        # 1-based arrival rank of each token within its expert (per
+        # group); tokens past capacity are DROPPED (their residual
+        # path carries them).
+        pos = jnp.cumsum(onehot, axis=1) * onehot
+        keep = (pos > 0) & (pos <= cap)
+        slot = jnp.clip(pos - 1, 0, cap - 1)
+        dispatch = (
+            keep[..., None] & jax.nn.one_hot(slot, cap, dtype=bool)
+        ).astype(dt)                                 # (G, g, e, cap)
+
+        expert_in = jnp.einsum("gnec,gnd->gecd", dispatch,
+                               tokens.astype(dt))    # (G, e, cap, d)
+        w_in = self.param("moe_w_in", nn.initializers.lecun_normal(),
+                          (e, d, cfg.d_ff))
+        b_in = self.param("moe_b_in", nn.initializers.zeros, (e, cfg.d_ff))
+        w_out = self.param("moe_w_out", nn.initializers.lecun_normal(),
+                           (e, cfg.d_ff, d))
+        b_out = self.param("moe_b_out", nn.initializers.zeros, (e, d))
+        h = jnp.einsum("gecd,edf->gecf", expert_in, w_in.astype(dt))
+        h = nn.gelu(h + b_in[None, :, None].astype(dt))
+        expert_out = jnp.einsum("gecf,efd->gecd", h, w_out.astype(dt))
+        expert_out = expert_out + b_out[None, :, None].astype(dt)
+
+        combine = dispatch * gate[..., None, None].astype(dt)
+        out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
+
+        # Switch load-balance loss: e * sum_e fraction_e * prob_e,
+        # averaged over groups.
+        frac = jnp.mean(onehot.astype(jnp.float32), axis=1)   # (G, e)
+        mean_prob = jnp.mean(probs, axis=1)                   # (G, e)
+        aux = cfg.moe_aux_weight * e * jnp.mean(
+            jnp.sum(frac * mean_prob, axis=-1)
+        )
+        self.sow("losses", "moe_aux", aux)
+        return out.reshape(b, s, d)
+
+
 class EncoderLayer(nn.Module):
     config: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -107,9 +210,12 @@ class EncoderLayer(nn.Module):
         h = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
         x = x + MultiHeadAttention(cfg, name="attn")(h)
         h = nn.LayerNorm(dtype=dt, name="ln_mlp")(x)
-        h = nn.Dense(cfg.d_ff, dtype=dt, name="mlp_in")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.d_model, dtype=dt, name="mlp_out")(h)
+        if self.use_moe:
+            h = MoEFFN(cfg, name="moe")(h)
+        else:
+            h = nn.Dense(cfg.d_ff, dtype=dt, name="mlp_in")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.d_model, dtype=dt, name="mlp_out")(h)
         return x + h
 
 
@@ -137,7 +243,10 @@ class Transformer(nn.Module):
         if cfg.remat:
             layer = nn.remat(EncoderLayer)
         for i in range(cfg.n_layers):
-            x = layer(cfg, name=f"layer_{i}")(x)
+            use_moe = (
+                cfg.n_experts > 0 and (i + 1) % max(1, cfg.moe_every) == 0
+            )
+            x = layer(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
         return nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_final")(x)
 
 

@@ -21,7 +21,12 @@ from typing import Callable, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparktorch_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP, fsdp_param_sharding
+from sparktorch_tpu.parallel.mesh import (
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_TP,
+    fsdp_param_sharding,
+)
 
 
 # (path regex, spec builder taking leaf ndim) — first match wins.
@@ -38,6 +43,13 @@ _TRANSFORMER_RULES = [
     # Embeddings: vocab over tp, model dim over fsdp.
     (re.compile(r".*tok_embed/embedding$"), lambda nd: P(AXIS_TP, AXIS_FSDP)),
     (re.compile(r".*lm_head/kernel$"), lambda nd: P(None, AXIS_TP)),
+    # Mixture-of-experts: experts dim over ep; the FFN's inner dim
+    # additionally over tp (column then row parallel, like the dense
+    # MLP). The router is tiny and stays replicated (no rule).
+    (re.compile(r".*moe_w_in$"), lambda nd: P(AXIS_EP, None, AXIS_TP)),
+    (re.compile(r".*moe_b_in$"), lambda nd: P(AXIS_EP, AXIS_TP)),
+    (re.compile(r".*moe_w_out$"), lambda nd: P(AXIS_EP, AXIS_TP, None)),
+    (re.compile(r".*moe_b_out$"), lambda nd: P(AXIS_EP, None)),
 ]
 
 

@@ -79,12 +79,16 @@ def create_sharded_state(
     with jax.set_mesh(mesh):
         abstract = jax.eval_shape(lambda k: module.init(k, sample_x), rng)
         a_params, a_state = _split_variables(abstract)
+        # 'losses' is a write-only collection (sown aux objectives);
+        # it must never live in the carried train state — see step().
+        a_state = {k: v for k, v in a_state.items() if k != "losses"}
         param_sh = shard_params(a_params, mesh, rules)
         state_sh = jax.tree.map(lambda _: replicated(mesh), a_state)
 
         def init_all(key):
             variables = module.init(key, sample_x)
             params, mstate = _split_variables(variables)
+            mstate = {k: v for k, v in mstate.items() if k != "losses"}
             opt_state = tx.init(params)
             return params, mstate, opt_state
 
@@ -140,16 +144,27 @@ def make_sharded_train_step(
     def step(state: TrainState, batch: DataBatch):
         def weighted_mean_loss(params):
             variables = {"params": params, **state.model_state}
-            if state.model_state:
-                preds, new_state = apply_fn(
-                    variables, batch.x, mutable=list(state.model_state.keys())
-                )
-            else:
-                preds, new_state = apply_fn(variables, batch.x), state.model_state
+            # 'losses' is write-only: requested mutable every step so
+            # sow() records fresh values, but never carried in the
+            # train state (sow APPENDS to carried-in collections,
+            # which would grow the pytree every step).
+            mutable = [*state.model_state.keys(), "losses"]
+            preds, new_state = apply_fn(variables, batch.x, mutable=mutable)
+            new_state = dict(new_state)
+            sown = new_state.pop("losses", None)
+            if not state.model_state:
+                new_state = state.model_state
             per = loss_fn(preds, batch.y)
             num = jnp.sum(per * batch.w)
             den = jnp.maximum(jnp.sum(batch.w), 1.0)
-            return num / den, (den, new_state)
+            loss = num / den
+            # Sown auxiliary objectives (e.g. the MoE load-balance
+            # loss, already weighted at the sow site) join the task
+            # loss so their gradients flow.
+            if sown is not None:
+                for leaf in jax.tree.leaves(sown):
+                    loss = loss + jnp.sum(leaf).astype(loss.dtype)
+            return loss, (den, new_state)
 
         (loss, (den, new_model_state)), grads = jax.value_and_grad(
             weighted_mean_loss, has_aux=True

@@ -59,6 +59,12 @@ class StepMetrics(NamedTuple):
 def _split_variables(variables) -> Tuple[Any, Any]:
     variables = dict(variables)
     params = variables.pop("params", variables)
+    # 'losses' is a write-only collection (sown aux objectives, e.g.
+    # the MoE load-balance loss); carrying it would make sow() append
+    # to it every step and grow the pytree. The sharded trainer
+    # re-requests it via `mutable` each step; the DP trainer ignores
+    # it (sow is a no-op when the collection isn't mutable).
+    variables.pop("losses", None)
     return params, variables
 
 
