@@ -32,10 +32,6 @@ from jax.sharding import PartitionSpec as P
 from sparktorch_tpu.ops.attention import dense_attention, ring_attention
 from sparktorch_tpu.parallel.mesh import BATCH_AXES
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,15 +87,16 @@ class MultiHeadAttention(nn.Module):
 
             out = flash_attention(q, k, v, cfg.causal)
         elif cfg.attn_impl == "ring":
+            from sparktorch_tpu.train.step import shard_map_compat
+
             spec = P(BATCH_AXES, "sp", "tp", None)
-            attn = shard_map(
+            attn = shard_map_compat(
                 lambda q, k, v: ring_attention(
                     q, k, v, axis_name="sp", causal=cfg.causal
                 ),
                 mesh=None,  # ambient mesh (jax.set_mesh)
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
-                check_vma=False,
             )
             out = attn(q, k, v)
         else:

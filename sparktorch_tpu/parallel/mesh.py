@@ -37,7 +37,8 @@ AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 AXIS_EP = "ep"
-ALL_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_EP)
+AXIS_PP = "pp"
+ALL_AXES = (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_EP, AXIS_PP)
 # Axes over which the batch dimension is split (and grads are summed).
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)
 
@@ -54,19 +55,20 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> dict:
-        fixed = self.fsdp * self.tp * self.sp * self.ep
+        fixed = self.fsdp * self.tp * self.sp * self.ep * self.pp
         if n_devices % fixed != 0:
             raise ValueError(
-                f"{n_devices} devices not divisible by fsdp*tp*sp*ep={fixed}"
+                f"{n_devices} devices not divisible by fsdp*tp*sp*ep*pp={fixed}"
             )
         dp = self.dp if self.dp is not None else n_devices // fixed
         total = dp * fixed
         if total != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.ep} = {total} "
-                f"!= {n_devices} devices"
+                f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp}x{self.ep}"
+                f"x{self.pp} = {total} != {n_devices} devices"
             )
         return {
             AXIS_DP: dp,
@@ -74,6 +76,7 @@ class MeshConfig:
             AXIS_TP: self.tp,
             AXIS_SP: self.sp,
             AXIS_EP: self.ep,
+            AXIS_PP: self.pp,
         }
 
 

@@ -1,0 +1,241 @@
+"""GPipe pipeline parallelism over the ``pp`` mesh axis.
+
+No reference counterpart (SURVEY §2.4: PP "absent"). TPU-first
+design: the transformer stack is split into ``pp`` stages — the
+stacked per-layer params are sharded over ``pp`` on their leading
+(layer) dim — and a ``shard_map`` step runs the classic GPipe
+schedule: microbatches enter at stage 0, activations hop stage→stage
+on an ICI ring via ``lax.ppermute``, the last stage accumulates the
+weighted loss, and autodiff THROUGH the schedule (ppermute transposes
+to the reverse permute) yields exact gradients — mathematically
+identical to gradient accumulation over the microbatches on one
+device, which is what the parity test asserts.
+
+The whole schedule (M + S - 1 ticks) is one ``lax.scan`` inside one
+jitted ``shard_map``: zero per-tick Python, static shapes, and the
+bubble is the textbook (S-1)/(M+S-1) fraction — raise ``n_micro`` to
+shrink it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparktorch_tpu.models.transformer import EncoderLayer, TransformerConfig
+from sparktorch_tpu.parallel.mesh import AXIS_DP, AXIS_PP
+from sparktorch_tpu.train.step import shard_map_compat
+from sparktorch_tpu.utils.data import DataBatch
+
+
+class PipelineState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def init_pipeline_lm(cfg: TransformerConfig, key: jax.Array):
+    """Host-side init of a causal LM laid out for pipelining: the
+    encoder layers' params are STACKED on a leading (n_layers) dim —
+    the dim the pp sharding splits — plus replicated embedding / final
+    norm / LM head tensors."""
+    cfg = dataclasses.replace(cfg, causal=True)
+    layer = EncoderLayer(cfg)
+    k_embed, k_pos, k_head, k_layers = jax.random.split(key, 4)
+    sample_h = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.compute_dtype)
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    stacked = jax.vmap(lambda k: layer.init(k, sample_h)["params"])(layer_keys)
+    d = cfg.d_model
+    params = {
+        "layers": stacked,  # every leaf: (n_layers, ...)
+        "tok_embed": jax.random.normal(k_embed, (cfg.vocab_size, d)) * 0.02,
+        "pos_embed": jax.random.normal(k_pos, (cfg.max_len, d)) * 0.02,
+        "ln_scale": jnp.ones((d,)),
+        "ln_bias": jnp.zeros((d,)),
+        "head_w": jax.random.normal(k_head, (d, cfg.vocab_size))
+        * (1.0 / np.sqrt(d)),
+        "head_b": jnp.zeros((cfg.vocab_size,)),
+    }
+    return params
+
+
+def _param_specs(params) -> Any:
+    """Per-leaf PartitionSpecs: layer stacks split over pp on their
+    leading (layer) dim; everything else replicated."""
+    return {
+        k: (
+            jax.tree.map(lambda _: P(AXIS_PP), v)
+            if k == "layers"
+            else jax.tree.map(lambda _: P(), v)
+        )
+        for k, v in params.items()
+    }
+
+
+def place_pipeline_state(params, tx, mesh: Mesh) -> PipelineState:
+    """device_put params into their pipeline layout and init the
+    optimizer on the placed arrays (eager optax init preserves input
+    shardings leaf-wise)."""
+    sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), _param_specs(params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    params = jax.tree.map(jax.device_put, params, sh)
+    opt_state = tx.init(params)
+    return PipelineState(step=jnp.zeros((), jnp.int32), params=params,
+                         opt_state=opt_state)
+
+
+def make_pp_train_step(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    n_micro: int,
+) -> Callable[[PipelineState, DataBatch], Tuple[PipelineState, jax.Array]]:
+    """Build the jitted pipelined train step over ``mesh`` (dp x pp;
+    other axes must be 1 for this trainer)."""
+    for ax in mesh.shape:
+        if ax not in (AXIS_DP, AXIS_PP) and mesh.shape[ax] != 1:
+            raise ValueError(f"pipeline trainer supports dp x pp only; {ax}>1")
+    S = mesh.shape[AXIS_PP]
+    if cfg.n_layers % max(1, S) != 0:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={S}")
+    # The pipelined stack is the homogeneous dense EncoderLayer; fail
+    # loudly rather than silently training a different model.
+    if cfg.n_experts > 0:
+        raise ValueError("pipeline trainer does not support MoE layers yet")
+    if cfg.remat:
+        raise ValueError("pipeline trainer does not support remat yet")
+    cfg = dataclasses.replace(cfg, causal=True)
+    layer = EncoderLayer(cfg)
+    dt = cfg.compute_dtype
+
+    def stage_fn(local_layers, h):
+        def body(h, lp):
+            return layer.apply({"params": lp}, h), None
+
+        h, _ = jax.lax.scan(body, h, local_layers)
+        return h
+
+    def embed(params, ids):
+        s = ids.shape[1]
+        h = params["tok_embed"][ids] + params["pos_embed"][None, :s]
+        return h.astype(dt)
+
+    def head_loss(params, h, y, w):
+        hf = h.astype(jnp.float32)
+        mean = hf.mean(-1, keepdims=True)
+        var = ((hf - mean) ** 2).mean(-1, keepdims=True)
+        hf = (hf - mean) / jnp.sqrt(var + 1e-6)
+        hf = hf * params["ln_scale"] + params["ln_bias"]
+        logits = hf @ params["head_w"] + params["head_b"]
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        per_ex = per_tok.mean(-1)
+        return jnp.sum(per_ex * w), jnp.sum(w)
+
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def local_step(params, opt_state, x, y, w):
+        stage = jax.lax.axis_index(AXIS_PP)
+        b_local, s = x.shape
+        if b_local % n_micro != 0:
+            raise ValueError(
+                f"local batch {b_local} not divisible by n_micro={n_micro}"
+            )
+        mb = b_local // n_micro
+        micro_x = x.reshape(n_micro, mb, s)
+        micro_y = y.reshape(n_micro, mb, s)
+        micro_w = w.reshape(n_micro, mb)
+
+        def pipeline_loss(params):
+            def tick(carry, t):
+                h_prev, num, den = carry
+                inj = jnp.clip(t, 0, n_micro - 1)
+                x_in = embed(params, micro_x[inj])
+                h_in = jnp.where(stage == 0, x_in, h_prev)
+                h_out = stage_fn(params["layers"], h_in)
+                m = t - (S - 1)
+                mi = jnp.clip(m, 0, n_micro - 1)
+                n_, d_ = head_loss(params, h_out, micro_y[mi], micro_w[mi])
+                use = ((m >= 0) & (m < n_micro) & (stage == S - 1)).astype(
+                    jnp.float32
+                )
+                num = num + use * n_
+                den = den + use * d_
+                h_next = jax.lax.ppermute(h_out, AXIS_PP, ring)
+                return (h_next, num, den), None
+
+            init_h = jnp.zeros((mb, s, cfg.d_model), dt)
+            (_, num, den), _ = jax.lax.scan(
+                tick, (init_h, jnp.zeros(()), jnp.zeros(())),
+                jnp.arange(n_micro + S - 1),
+            )
+            num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
+            den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
+            return num_g / jnp.maximum(den_g, 1.0)
+
+        loss, grads = jax.value_and_grad(pipeline_loss)(params)
+        # Replicated-param grads must be summed over every axis the
+        # param is replicated across: layer stacks live on one pp
+        # shard each (sum over dp only); embed/head/norm are used on
+        # all stages (masked elsewhere -> zero grads) and replicated
+        # over both axes.
+        grads = {
+            k: (
+                jax.tree.map(lambda g: jax.lax.psum(g, AXIS_DP), v)
+                if k == "layers"
+                else jax.tree.map(
+                    lambda g: jax.lax.psum(g, (AXIS_PP, AXIS_DP)), v
+                )
+            )
+            for k, v in grads.items()
+        }
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    cache = {}
+
+    def step(state: PipelineState, batch: DataBatch):
+        if "jitted" not in cache:
+            specs = _param_specs(state.params)
+            opt_specs = _opt_specs(tx, state.opt_state, specs)
+            mapped = shard_map_compat(
+                local_step,
+                mesh,
+                in_specs=(specs, opt_specs,
+                          P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
+                out_specs=(specs, opt_specs, P()),
+            )
+            cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
+        new_params, new_opt, loss = cache["jitted"](
+            state.params, state.opt_state, batch.x, batch.y, batch.w
+        )
+        return (
+            PipelineState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt),
+            loss,
+        )
+
+    return step
+
+
+def _opt_specs(tx, opt_state, param_specs):
+    """Optimizer leaves that mirror the param TREE (Adam moments etc.)
+    inherit the matching param's spec exactly — structural matching
+    via ``optax.tree_map_params``, not shape heuristics (two params
+    can share a shape); every non-param leaf replicates."""
+    return optax.tree_map_params(
+        tx,
+        lambda _, spec: spec,
+        opt_state,
+        param_specs,
+        transform_non_params=lambda _: P(),
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -78,17 +78,16 @@ def create_sharded_state(
     # attention shard_map island, which resolves the ambient mesh.
     with jax.set_mesh(mesh):
         abstract = jax.eval_shape(lambda k: module.init(k, sample_x), rng)
+        # _split_variables drops the write-only 'losses' collection
+        # (sown aux objectives), which must never live in the carried
+        # train state — see step().
         a_params, a_state = _split_variables(abstract)
-        # 'losses' is a write-only collection (sown aux objectives);
-        # it must never live in the carried train state — see step().
-        a_state = {k: v for k, v in a_state.items() if k != "losses"}
         param_sh = shard_params(a_params, mesh, rules)
         state_sh = jax.tree.map(lambda _: replicated(mesh), a_state)
 
         def init_all(key):
             variables = module.init(key, sample_x)
             params, mstate = _split_variables(variables)
-            mstate = {k: v for k, v in mstate.items() if k != "losses"}
             opt_state = tx.init(params)
             return params, mstate, opt_state
 

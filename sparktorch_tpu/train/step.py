@@ -33,9 +33,21 @@ from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, replicated
 from sparktorch_tpu.utils.data import DataBatch, sample_minibatch
 
 try:  # jax>=0.6 top-level export; fall back for older trees
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _shard_map_raw
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the API rename
+    (new keyword ``check_vma``; the legacy API spells it
+    ``check_rep``)."""
+    try:
+        return _shard_map_raw(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover - legacy jax
+        return _shard_map_raw(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
 
 
 class TrainState(NamedTuple):
@@ -183,12 +195,11 @@ def make_train_step(
 
     data_spec = P(axis_names)
     batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
-    mapped = _shard_map(
+    mapped = shard_map_compat(
         shard_step,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), batch_specs),
         out_specs=(P(), P()),
-        check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -265,12 +276,11 @@ def make_train_epoch(
 
     data_spec = P(axis_names)
     batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
-    mapped = _shard_map(
+    mapped = shard_map_compat(
         shard_epoch,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), batch_specs),
         out_specs=(P(), P()),
-        check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -295,11 +305,10 @@ def make_eval_step(
 
     data_spec = P(axis_names)
     batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
-    mapped = _shard_map(
+    mapped = shard_map_compat(
         shard_eval,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), batch_specs),
         out_specs=P(),
-        check_vma=False,
     )
     return jax.jit(mapped)
