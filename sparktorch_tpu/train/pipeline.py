@@ -112,6 +112,13 @@ def make_pp_train_step(
         raise ValueError("pipeline trainer does not support MoE layers yet")
     if cfg.remat:
         raise ValueError("pipeline trainer does not support remat yet")
+    if cfg.attn_impl != "dense":
+        # ring/flash open their own shard_map / Pallas islands, which
+        # do not compose with the pp shard_map schedule yet.
+        raise ValueError(
+            f"pipeline trainer supports attn_impl='dense' only "
+            f"(got {cfg.attn_impl!r})"
+        )
     cfg = dataclasses.replace(cfg, causal=True)
     layer = EncoderLayer(cfg)
     dt = cfg.compute_dtype
@@ -157,17 +164,27 @@ def make_pp_train_step(
             def tick(carry, t):
                 h_prev, num, den = carry
                 inj = jnp.clip(t, 0, n_micro - 1)
-                x_in = embed(params, micro_x[inj])
-                h_in = jnp.where(stage == 0, x_in, h_prev)
+                # Only stage 0 embeds and only the last stage (inside
+                # its valid drain window) runs the vocab-sized head —
+                # lax.cond skips the dead branch at runtime instead of
+                # computing it everywhere and masking to zero (the
+                # head matmul + its backward dominate for real vocabs).
+                h_in = jax.lax.cond(
+                    stage == 0,
+                    lambda: embed(params, micro_x[inj]),
+                    lambda: h_prev,
+                )
                 h_out = stage_fn(params["layers"], h_in)
                 m = t - (S - 1)
                 mi = jnp.clip(m, 0, n_micro - 1)
-                n_, d_ = head_loss(params, h_out, micro_y[mi], micro_w[mi])
-                use = ((m >= 0) & (m < n_micro) & (stage == S - 1)).astype(
-                    jnp.float32
+                use = (m >= 0) & (m < n_micro) & (stage == S - 1)
+                n_, d_ = jax.lax.cond(
+                    use,
+                    lambda: head_loss(params, h_out, micro_y[mi], micro_w[mi]),
+                    lambda: (jnp.zeros(()), jnp.zeros(())),
                 )
-                num = num + use * n_
-                den = den + use * d_
+                num = num + n_
+                den = den + d_
                 h_next = jax.lax.ppermute(h_out, AXIS_PP, ring)
                 return (h_next, num, den), None
 

@@ -436,6 +436,9 @@ def train_distributed_streaming(
     verbose: int = 0,
     seed: int = 0,
     metrics_hook: Optional[Callable[[dict], None]] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ) -> TrainResult:
     """Train on data LARGER than device HBM by streaming host chunks.
 
@@ -517,40 +520,74 @@ def train_distributed_streaming(
 
     from sparktorch_tpu.utils.metrics import MetricsRecorder
 
+    ckpt = None
+    last_ckpt_step = 0
+    if checkpoint_dir:
+        from sparktorch_tpu.utils.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(checkpoint_dir)
+        if resume and ckpt.latest_step() is not None:
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=a.sharding),
+                state,
+            )
+            state = ckpt.restore(abstract)
+        last_ckpt_step = int(jax.device_get(state.step))
+
     recorder = MetricsRecorder(n_chips=mesh.size)
     shuffle_rng = np.random.default_rng(seed + 1)
     it_counter = 0
-    for epoch in range(max(1, epochs)):
-        check_gang()
-        order = shuffle_rng.permutation(n)
-        starts = list(range(0, n, chunk_rows))
-        resident = put_chunk(starts[0], order)
-        for ci, lo in enumerate(starts):
-            t0 = time.perf_counter()
-            state, metrics = step_fn(state, resident)
-            # Enqueue the NEXT chunk's host->device copy while the
-            # current chunk's (already dispatched) steps compute.
-            if ci + 1 < len(starts):
-                resident = put_chunk(starts[ci + 1], order)
-            losses = np.asarray(metrics.loss).reshape(-1)
-            examples = np.asarray(metrics.examples).reshape(-1)
-            dt = (time.perf_counter() - t0) / len(losses)
-            for j in range(len(losses)):
-                record = {
-                    "round": epoch, "iter": it_counter,
-                    "loss": float(losses[j]),
-                    "val_loss": None,
-                    "examples": float(examples[j]),
-                    "grad_norm": None,
-                    "step_time_s": dt,
-                }
-                recorder.record(record)
-                if metrics_hook:
-                    metrics_hook(record)
-                it_counter += 1
-            if verbose:
-                print(f"[sparktorch_tpu] epoch {epoch} chunk {ci} "
-                      f"loss {losses[-1]:.6f}")
+    completed = False
+    try:
+        for epoch in range(max(1, epochs)):
+            check_gang()
+            order = shuffle_rng.permutation(n)
+            starts = list(range(0, n, chunk_rows))
+            resident = put_chunk(starts[0], order)
+            for ci, lo in enumerate(starts):
+                t0 = time.perf_counter()
+                state, metrics = step_fn(state, resident)
+                # Enqueue the NEXT chunk's host->device copy while the
+                # current chunk's (already dispatched) steps compute.
+                if ci + 1 < len(starts):
+                    resident = put_chunk(starts[ci + 1], order)
+                losses = np.asarray(metrics.loss).reshape(-1)
+                examples = np.asarray(metrics.examples).reshape(-1)
+                dt = (time.perf_counter() - t0) / len(losses)
+                for j in range(len(losses)):
+                    record = {
+                        "round": epoch, "iter": it_counter,
+                        "loss": float(losses[j]),
+                        "val_loss": None,
+                        "examples": float(examples[j]),
+                        "grad_norm": None,
+                        "step_time_s": dt,
+                    }
+                    recorder.record(record)
+                    if metrics_hook:
+                        metrics_hook(record)
+                    it_counter += 1
+                if ckpt is not None and checkpoint_every > 0:
+                    # Chunk boundaries are the save points (same
+                    # first-boundary-at-or-past-cadence rule as the
+                    # resident trainer).
+                    step_now = int(jax.device_get(state.step))
+                    if step_now - last_ckpt_step >= checkpoint_every:
+                        ckpt.save(step_now, state)
+                        last_ckpt_step = step_now
+                if verbose:
+                    print(f"[sparktorch_tpu] epoch {epoch} chunk {ci} "
+                          f"loss {losses[-1]:.6f}")
+        completed = True
+    finally:
+        if ckpt is not None:
+            if completed:
+                final_step = int(jax.device_get(state.step))
+                if ckpt.latest_step() != final_step:
+                    ckpt.save(final_step, state, force=True)
+            ckpt.wait()
+            ckpt.close()
     params = jax.device_get(state.params)
     model_state = jax.device_get(state.model_state)
     return TrainResult(params=params, model_state=model_state,

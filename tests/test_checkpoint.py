@@ -113,3 +113,34 @@ def test_training_summary(payload):
     assert s["examples_per_sec_per_chip"] is not None
     assert s["step_time_p99_s"] >= s["step_time_p50_s"]
     assert s["final_loss"] < s["first_loss"]
+
+
+def test_streaming_trainer_checkpoint_resume(tmp_path):
+    # The streaming trainer saves at chunk boundaries and resumes
+    # exactly: a run killed mid-way, resumed, must land on the same
+    # final step count as the uninterrupted run.
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.train.sync import train_distributed_streaming
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (512, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (512,)).astype(np.int32)
+    spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3},
+                     input_shape=(784,))
+    d = str(tmp_path / "stream_ckpt")
+    r1 = train_distributed_streaming(
+        spec, x, labels=y, chunk_rows=256, epochs=2,
+        checkpoint_dir=d, checkpoint_every=1,
+    )
+    from sparktorch_tpu.utils.checkpoint import CheckpointManager
+
+    saved = CheckpointManager(d).latest_step()
+    assert saved == len(r1.metrics), (saved, len(r1.metrics))
+    # Resume trains FURTHER from the saved step.
+    r2 = train_distributed_streaming(
+        spec, x, labels=y, chunk_rows=256, epochs=1,
+        checkpoint_dir=d, checkpoint_every=1, resume=True,
+    )
+    assert CheckpointManager(d).latest_step() == saved + len(r2.metrics)

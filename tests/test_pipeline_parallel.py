@@ -82,3 +82,12 @@ def test_pipeline_rejects_bad_config():
     mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
     with pytest.raises(ValueError):
         make_pp_train_step(cfg, optax.adam(1e-2), mesh, n_micro=4)
+
+
+def test_pipeline_rejects_nondense_attention():
+    import optax
+
+    cfg = _cfg(attn_impl="ring")
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    with pytest.raises(ValueError):
+        make_pp_train_step(cfg, optax.adam(1e-2), mesh, n_micro=4)
