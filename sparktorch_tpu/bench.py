@@ -363,6 +363,46 @@ def bench_long_context_lm() -> dict:
     }
 
 
+def bench_moe_lm() -> dict:
+    """Beyond the reference: switch-style MoE causal LM on one chip
+    (ep=1 layout; the all-to-all layout is exercised by tests and the
+    multi-chip dry run). Reports tokens/sec and the MoE-vs-dense
+    step-time ratio at matched active params per token."""
+    import jax
+
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.models.transformer import TransformerConfig
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    vocab, batch, seq = 32768, 8, 1024
+
+    def spec_for(n_experts: int) -> ModelSpec:
+        cfg = TransformerConfig(
+            vocab_size=vocab, d_model=512, n_heads=8, n_layers=4,
+            d_ff=2048, max_len=seq, n_experts=n_experts, moe_every=2,
+        )
+        return ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                         optimizer="adamw", optimizer_params={"lr": 3e-4})
+
+    ids = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    moe = _sync_epoch_bench(spec_for(8), ids[:, :-1], ids[:, 1:], batch,
+                            iters=6, warmup=2, chunks=2)
+    dense = _sync_epoch_bench(spec_for(0), ids[:, :-1], ids[:, 1:], batch,
+                              iters=6, warmup=2, chunks=2)
+    return {
+        "config": "moe_lm", "unit": "tokens/sec/chip",
+        "n_experts": 8, "seq_len": seq,
+        "tokens_per_sec_per_chip": round(
+            moe["examples_per_sec_per_chip"] * seq, 1
+        ),
+        "moe_vs_dense_step_ratio": round(
+            moe["step_time_p50_s"] / dense["step_time_p50_s"], 3
+        ),
+        **moe,
+    }
+
+
 CONFIGS: Dict[str, Callable[[], dict]] = {
     "mnist_mlp_sync": bench_mnist_mlp_sync,
     "mnist_cnn_sync": bench_mnist_cnn_sync,
@@ -371,6 +411,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,
+    "moe_lm": bench_moe_lm,
 }
 
 

@@ -81,6 +81,54 @@ def _shuffle_batch(batch: DataBatch, key: jax.Array, mesh: Mesh) -> DataBatch:
     return out
 
 
+def _open_checkpoint(checkpoint_dir, resume, state):
+    """Shared checkpoint bring-up for the trainers: open the manager
+    and restore the latest snapshot when resuming. Returns
+    (manager_or_None, possibly-restored state)."""
+    if not checkpoint_dir:
+        return None, state
+    from sparktorch_tpu.utils.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(checkpoint_dir)
+    if resume and ckpt.latest_step() is not None:
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding),
+            state,
+        )
+        state = ckpt.restore(abstract)
+    return ckpt, state
+
+
+def _save_if_due(ckpt, state, last_ckpt_step: int, every: int) -> int:
+    """Save on the first boundary at or past the cadence — a fused
+    chunk that strides over the exact multiple must not silently skip
+    the save. Returns the (possibly advanced) last-saved step."""
+    if ckpt is None or every <= 0:
+        return last_ckpt_step
+    step_now = int(jax.device_get(state.step))
+    if step_now - last_ckpt_step >= every:
+        ckpt.save(step_now, state)
+        return step_now
+    return last_ckpt_step
+
+
+def _finalize_checkpoint(ckpt, state, completed: bool) -> None:
+    """Flush and close. The FINAL snapshot fires only on clean
+    completion — orbax saves are cross-process collectives, so
+    attempting one after a peer died would wedge the survivor in
+    exactly the hang check_gang() exists to prevent (periodic saves
+    already on disk keep the run resumable)."""
+    if ckpt is None:
+        return
+    if completed:
+        final_step = int(jax.device_get(state.step))
+        if ckpt.latest_step() != final_step:
+            ckpt.save(final_step, state, force=True)
+    ckpt.wait()
+    ckpt.close()
+
+
 def train_distributed(
     torch_obj: Union[str, ModelSpec],
     data: Any,
@@ -148,18 +196,7 @@ def train_distributed(
             out_shardings=replicated(mesh),
         )()
 
-    ckpt = None
-    if checkpoint_dir:
-        from sparktorch_tpu.utils.checkpoint import CheckpointManager
-
-        ckpt = CheckpointManager(checkpoint_dir)
-        if resume and ckpt.latest_step() is not None:
-            abstract = jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                               sharding=a.sharding),
-                state,
-            )
-            state = ckpt.restore(abstract)
+    ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
 
     loss_fn = spec.loss_fn()
     module = spec.make_module()
@@ -284,14 +321,9 @@ def train_distributed(
                             stop = True
                             break
                     i += 1
-                if ckpt is not None and checkpoint_every > 0:
-                    # Save on the first chunk boundary at or past the
-                    # cadence — a fused chunk that strides over the exact
-                    # multiple must not silently skip the save.
-                    step_now = int(jax.device_get(state.step))
-                    if step_now - last_ckpt_step >= checkpoint_every:
-                        ckpt.save(step_now, state)
-                        last_ckpt_step = step_now
+                last_ckpt_step = _save_if_due(
+                    ckpt, state, last_ckpt_step, checkpoint_every
+                )
                 if stop:
                     break
             if stop:
@@ -301,19 +333,8 @@ def train_distributed(
         # Cleanup must run on the failure paths too (GangFailure from
         # check_gang, a raising metrics_hook): close the profiler
         # trace and flush async checkpoint writes already in flight.
-        # The FINAL snapshot fires only on clean completion — orbax
-        # saves are cross-process collectives, so attempting one after
-        # a peer died would wedge the survivor in exactly the hang
-        # check_gang() exists to prevent (periodic saves from the loop
-        # above are still on disk for resume).
         profiler.__exit__(None, None, None)
-        if ckpt is not None:
-            if completed:
-                final_step = int(jax.device_get(state.step))
-                if ckpt.latest_step() != final_step:
-                    ckpt.save(final_step, state, force=True)
-            ckpt.wait()
-            ckpt.close()
+        _finalize_checkpoint(ckpt, state, completed)
 
     params = jax.device_get(state.params)
     model_state = jax.device_get(state.model_state)
@@ -520,23 +541,14 @@ def train_distributed_streaming(
 
     from sparktorch_tpu.utils.metrics import MetricsRecorder
 
-    ckpt = None
-    last_ckpt_step = 0
-    if checkpoint_dir:
-        from sparktorch_tpu.utils.checkpoint import CheckpointManager
-
-        ckpt = CheckpointManager(checkpoint_dir)
-        if resume and ckpt.latest_step() is not None:
-            abstract = jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                               sharding=a.sharding),
-                state,
-            )
-            state = ckpt.restore(abstract)
-        last_ckpt_step = int(jax.device_get(state.step))
+    ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
+    last_ckpt_step = int(jax.device_get(state.step)) if ckpt is not None else 0
 
     recorder = MetricsRecorder(n_chips=mesh.size)
-    shuffle_rng = np.random.default_rng(seed + 1)
+    # Fold the restored step into the shuffle seed: a resumed run must
+    # draw FRESH permutations, not replay the epochs the interrupted
+    # run already consumed.
+    shuffle_rng = np.random.default_rng(seed + 1 + last_ckpt_step)
     it_counter = 0
     completed = False
     try:
@@ -568,26 +580,16 @@ def train_distributed_streaming(
                     if metrics_hook:
                         metrics_hook(record)
                     it_counter += 1
-                if ckpt is not None and checkpoint_every > 0:
-                    # Chunk boundaries are the save points (same
-                    # first-boundary-at-or-past-cadence rule as the
-                    # resident trainer).
-                    step_now = int(jax.device_get(state.step))
-                    if step_now - last_ckpt_step >= checkpoint_every:
-                        ckpt.save(step_now, state)
-                        last_ckpt_step = step_now
+                # Chunk boundaries are the save points.
+                last_ckpt_step = _save_if_due(
+                    ckpt, state, last_ckpt_step, checkpoint_every
+                )
                 if verbose:
                     print(f"[sparktorch_tpu] epoch {epoch} chunk {ci} "
                           f"loss {losses[-1]:.6f}")
         completed = True
     finally:
-        if ckpt is not None:
-            if completed:
-                final_step = int(jax.device_get(state.step))
-                if ckpt.latest_step() != final_step:
-                    ckpt.save(final_step, state, force=True)
-            ckpt.wait()
-            ckpt.close()
+        _finalize_checkpoint(ckpt, state, completed)
     params = jax.device_get(state.params)
     model_state = jax.device_get(state.model_state)
     return TrainResult(params=params, model_state=model_state,
