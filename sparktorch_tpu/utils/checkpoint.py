@@ -40,7 +40,7 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: TrainState, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state._asdict()), force=force
         )
@@ -53,18 +53,19 @@ class CheckpointManager:
         """Retained checkpoint steps (bounded by ``max_to_keep``)."""
         return list(self._mgr.all_steps())
 
-    def restore(self, abstract_state: TrainState,
-                step: Optional[int] = None) -> TrainState:
+    def restore(self, abstract_state: Any,
+                step: Optional[int] = None) -> Any:
         """Restore into the layout described by ``abstract_state``
         (ShapeDtypeStructs with shardings — use ``jax.eval_shape`` +
-        the trainer's sharding pytree)."""
+        the trainer's sharding pytree). Works for any NamedTuple state
+        (TrainState, the pipeline trainer's PipelineState, ...)."""
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self._dir}")
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state._asdict())
         )
-        return TrainState(**restored)
+        return type(abstract_state)(**restored)
 
     def wait(self):
         self._mgr.wait_until_finished()
