@@ -21,11 +21,11 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
-from sparktorch_tpu.train.step import TrainState
 
 
 class CheckpointManager:
-    """Thin wrapper over ``ocp.CheckpointManager`` for TrainStates."""
+    """Thin wrapper over ``ocp.CheckpointManager`` for NamedTuple
+    train states (TrainState, PipelineState, ...)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1):
