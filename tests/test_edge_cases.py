@@ -82,33 +82,11 @@ def test_pipeline_single_microbatch():
 def test_moe_tiny_capacity_drops_tokens_but_trains():
     # capacity_factor far below 1: most tokens overflow and ride the
     # residual path; training must stay finite and still improve.
-    from sparktorch_tpu.models import CausalLM, tiny_transformer
-    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
-    from sparktorch_tpu.train.sharded import (
-        create_sharded_state, make_sharded_train_step, shard_batch,
-    )
-    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.parallel.mesh import MeshConfig
+    from tests.test_moe import _run_steps
 
-    cfg = tiny_transformer(vocab_size=128, d_model=32, n_heads=2,
-                           n_layers=2, d_ff=64, max_len=16, n_experts=4,
-                           moe_every=1, capacity_factor=0.25)
-    mesh = build_mesh(MeshConfig())
-    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
-                     optimizer="adamw", optimizer_params={"lr": 1e-2})
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, 128, (8, 17)).astype(np.int32)
-    batch = DataBatch(x=jnp.asarray(ids[:, :-1]), y=jnp.asarray(ids[:, 1:]),
-                      w=jnp.ones((8,), jnp.float32))
-    tx = spec.make_optimizer()
-    state, sh = create_sharded_state(spec, mesh, jax.random.key(0),
-                                     sample_x=np.asarray(batch.x[:1]), tx=tx)
-    step = make_sharded_train_step(spec.make_module().apply, spec.loss_fn(),
-                                   tx, mesh, sh)
-    b = shard_batch(batch, mesh)
-    losses = []
-    for _ in range(8):
-        state, m = step(state, b)
-        losses.append(float(m.loss))
+    losses = _run_steps(MeshConfig(), n_steps=8,
+                        moe_every=1, capacity_factor=0.25)
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
 

@@ -25,10 +25,10 @@ from sparktorch_tpu.utils.serde import ModelSpec
 
 
 def _moe_cfg(**over):
-    return tiny_transformer(
-        vocab_size=128, d_model=32, n_heads=2, n_layers=2, d_ff=64,
-        max_len=32, n_experts=4, moe_every=2, **over,
-    )
+    base = dict(vocab_size=128, d_model=32, n_heads=2, n_layers=2,
+                d_ff=64, max_len=32, n_experts=4, moe_every=2)
+    base.update(over)
+    return tiny_transformer(**base)
 
 
 def _lm_batch(cfg, b=8, seq=16, seed=0):
@@ -38,8 +38,8 @@ def _lm_batch(cfg, b=8, seq=16, seed=0):
                      w=jnp.ones((b,), jnp.float32))
 
 
-def _run_steps(mesh_cfg, n_steps=8, seed=0):
-    cfg = _moe_cfg()
+def _run_steps(mesh_cfg, n_steps=8, seed=0, **cfg_over):
+    cfg = _moe_cfg(**cfg_over)
     mesh = build_mesh(mesh_cfg)
     spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
                      optimizer="adamw", optimizer_params={"lr": 1e-2})
@@ -116,3 +116,12 @@ def test_moe_classifier_forward():
     assert "losses" not in mstate
     out = module.apply(variables, ids)
     assert out.shape == (2, cfg.n_classes)
+
+
+def test_moe_tp_ep_composition_parity():
+    # tp shards the experts' inner d_ff dim on top of ep sharding the
+    # expert dim; composed layouts must reproduce the dp-only numbers
+    # (layout is never allowed to change the math).
+    l_ref = _run_steps(MeshConfig(), n_steps=5)
+    l_comp = _run_steps(MeshConfig(dp=2, tp=2, ep=2), n_steps=5)
+    np.testing.assert_allclose(l_ref, l_comp, rtol=2e-3)
