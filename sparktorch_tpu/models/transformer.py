@@ -59,6 +59,9 @@ class TransformerConfig:
     # the dispatch/combine one-hots are O(n * group * cf) elements —
     # linear in total tokens — instead of O(n^2) with global routing.
     moe_group_size: int = 4096
+    # CausalLM: share the input embedding matrix with the LM head
+    # (logits = h @ E^T) — halves the vocab-sized params.
+    tie_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -222,14 +225,21 @@ class Transformer(nn.Module):
 
     config: TransformerConfig
 
+    # Optional externally-owned embedding module (weight tying: the
+    # CausalLM owns it and reuses it as the LM head).
+    embed: Optional[nn.Module] = None
+
     @nn.compact
     def __call__(self, ids):
         cfg = self.config
         if jnp.issubdtype(ids.dtype, jnp.floating):
             ids = ids.astype(jnp.int32)
         b, s = ids.shape
-        tok = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype,
-                       name="tok_embed")(ids)
+        embed = self.embed if self.embed is not None else nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype,
+            name="tok_embed",
+        )
+        tok = embed(ids)
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
@@ -274,11 +284,26 @@ class CausalLM(nn.Module):
 
     def setup(self):
         cfg = dataclasses.replace(self.config, causal=True)
-        self.backbone = Transformer(cfg)
-        self.lm_head = nn.Dense(cfg.vocab_size, dtype=jnp.float32)
+        if cfg.tie_embeddings:
+            # One vocab-sized matrix: the embedding doubles as the LM
+            # head (logits = h @ E^T via nn.Embed.attend).
+            self.tok_embed = nn.Embed(
+                cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype,
+                name="tok_embed",
+            )
+            self.backbone = Transformer(cfg, embed=self.tok_embed)
+        else:
+            self.backbone = Transformer(cfg)
+            self.lm_head = nn.Dense(cfg.vocab_size, dtype=jnp.float32)
 
     def __call__(self, ids):
         x = self.backbone(ids)
+        if self.config.tie_embeddings:
+            # f32 logits like the untied Dense head (attend would run
+            # the vocab matmul in the embed's compute dtype; logit
+            # precision matters for the CE loss and its gradients).
+            emb = self.tok_embed.embedding
+            return x.astype(jnp.float32) @ emb.astype(jnp.float32).T
         return self.lm_head(x)
 
 

@@ -107,3 +107,29 @@ def test_resnet_lazy_serialization():
     spec = deserialize_model(payload)
     variables = spec.init_params(jax.random.key(0))
     assert "batch_stats" in variables
+
+
+def test_causal_lm_weight_tying():
+    # tie_embeddings=True: one vocab-sized matrix serves as both input
+    # embedding and LM head; the untied variant carries both.
+    import jax
+
+    from sparktorch_tpu.models import CausalLM, tiny_transformer
+
+    ids = np.zeros((2, 8), np.int32)
+    tied = CausalLM(tiny_transformer(tie_embeddings=True))
+    v_tied = tied.init(jax.random.key(0), ids)
+    flat = jax.tree_util.tree_flatten_with_path(v_tied["params"])[0]
+    paths = ["/".join(str(getattr(k, "key", k)) for k in p) for p, _ in flat]
+    assert any("tok_embed" in p for p in paths)
+    assert not any("lm_head" in p for p in paths)
+
+    untied = CausalLM(tiny_transformer())
+    v_untied = untied.init(jax.random.key(0), ids)
+    n_tied = sum(x.size for x in jax.tree.leaves(v_tied["params"]))
+    n_untied = sum(x.size for x in jax.tree.leaves(v_untied["params"]))
+    assert n_untied > n_tied  # the extra vocab-sized head
+
+    out = tied.apply(v_tied, ids)
+    assert out.shape == (2, 8, 256)
+    assert out.dtype == jnp.float32
