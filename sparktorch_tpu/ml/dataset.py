@@ -13,7 +13,7 @@ of row-dicts, a pandas DataFrame, or another LocalDataFrame.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List
 
 import numpy as np
 

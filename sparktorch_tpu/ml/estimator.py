@@ -21,7 +21,7 @@ configs unchanged. TPU-native differences:
 from __future__ import annotations
 
 import base64
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple
 
 import dill
 import numpy as np

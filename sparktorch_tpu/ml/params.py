@@ -14,7 +14,6 @@ adapter (``sparktorch_tpu.spark``) maps these onto real Spark Params
 from __future__ import annotations
 
 import functools
-import inspect
 from typing import Any, Callable, Dict, Optional
 
 

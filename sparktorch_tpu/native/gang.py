@@ -23,7 +23,7 @@ from __future__ import annotations
 import ctypes
 import threading
 import time
-from typing import List, Optional
+from typing import List
 
 from sparktorch_tpu.native.build import load_library
 

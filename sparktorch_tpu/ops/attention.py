@@ -22,8 +22,6 @@ streaming-softmax max/denominator carried per query.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

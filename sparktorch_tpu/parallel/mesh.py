@@ -24,7 +24,6 @@ driver dispatches, it does not participate).
 from __future__ import annotations
 
 import dataclasses
-import math
 import os
 from typing import Optional, Sequence
 

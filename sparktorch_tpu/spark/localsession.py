@@ -38,7 +38,7 @@ import subprocess
 import sys
 import tempfile
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 

@@ -34,8 +34,6 @@ Spark deployments (pyspark is not in this repo's test image).
 
 from __future__ import annotations
 
-from typing import Any, Optional
-
 import numpy as np
 
 try:
@@ -262,7 +260,6 @@ class SparkTorch(Estimator, _SparkTorchParams):
                 import os as _os
 
                 import jax as _jax
-                import jax.numpy as _jnp
 
                 from sparktorch_tpu.train.hogwild import (
                     HttpTransport,

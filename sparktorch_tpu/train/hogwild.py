@@ -27,7 +27,6 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from functools import partial
 from typing import Any, List, Optional
 
 import dill
@@ -38,7 +37,7 @@ import numpy as np
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
 from sparktorch_tpu.train.sync import TrainResult, _as_batch
 from sparktorch_tpu.utils.data import DataBatch
-from sparktorch_tpu.utils.serde import ModelSpec, deserialize_model
+from sparktorch_tpu.utils.serde import deserialize_model
 
 _HTTP_TIMEOUT = 10.0  # hogwild.py:34-38 parity (10s timeout, 1 retry)
 

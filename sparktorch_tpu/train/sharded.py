@@ -16,8 +16,7 @@ returns a step already wrapped to do so.
 
 from __future__ import annotations
 
-import contextlib
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -21,15 +21,14 @@ already the global mean, replicated on every host
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, replicated
+from sparktorch_tpu.parallel.mesh import BATCH_AXES, replicated
 from sparktorch_tpu.utils.data import DataBatch, sample_minibatch
 
 try:  # jax>=0.6 top-level export; fall back for older trees
