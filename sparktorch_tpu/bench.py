@@ -1,13 +1,17 @@
-"""Benchmark suite: the five BASELINE.md configs on real TPU.
+"""Benchmark suite: the BASELINE.md configs (plus extensions) on real TPU.
 
 The reference publishes no numbers (BASELINE.md), so these are the
-project's measured baselines. Configs (BASELINE.json):
+project's measured baselines. BASELINE.json configs:
 
 1. mnist_mlp_sync     — MNIST 3-layer MLP, synchronous DP
 2. lazy_cnn_sync      — MNIST CNN with LAZY model materialization
 3. resnet18_hogwild   — ResNet-18/CIFAR-10 shapes, async param server
 4. bert_dp            — BERT-base-shape encoder, sync DP (compute-bound)
 5. resnet50_inference — ResNet-50 batch inference (1M-row projection)
+
+Extensions beyond the reference's scope: mnist_cnn_sync (the headline),
+long_context_lm (flash kernels at seq 8192), moe_lm (switch MoE vs its
+dense twin).
 
 Each bench returns a summary dict (examples/sec/chip + p50/p99 step
 times where steps exist) and appends raw per-phase records to a JSONL
