@@ -101,6 +101,14 @@ void handle_conn(GangServer *srv, int fd) {
         write_all(fd, "ERR bad rank\n");
         continue;
       }
+      // A failed gang stays failed: re-registration after the member
+      // was declared dead must not resurrect the slot and mask the
+      // gang-wide DEAD verdict peers were already told about. The
+      // dialer sees DEAD, which its client treats as authoritative.
+      if (st.failed.load()) {
+        write_all(fd, "DEAD\n");
+        continue;
+      }
       {
         std::lock_guard<std::mutex> lock(st.mu);
         st.members[rank] = addr;
@@ -298,8 +306,11 @@ void gang_server_stop(void *p) {
   delete srv;
 }
 
-void *gang_client_connect(const char *host, int port, int rank,
-                          const char *addr, int timeout_ms) {
+// status (when non-null): 0 = registered, 1 = coordinator replied DEAD
+// (the gang already failed — authoritative, do not retry), -1 = io/ERR.
+void *gang_client_connect2(const char *host, int port, int rank,
+                           const char *addr, int timeout_ms, int *status) {
+  if (status) *status = -1;
   int fd = dial(host, port, timeout_ms);
   if (fd < 0) return nullptr;
   auto *cli = new GangClient{fd, rank};
@@ -307,11 +318,18 @@ void *gang_client_connect(const char *host, int port, int rank,
   std::string resp;
   if (!write_all(fd, msg) || !read_line(fd, &resp) ||
       resp.rfind("OK", 0) != 0) {
+    if (status && resp == "DEAD") *status = 1;
     close(fd);
     delete cli;
     return nullptr;
   }
+  if (status) *status = 0;
   return cli;
+}
+
+void *gang_client_connect(const char *host, int port, int rank,
+                          const char *addr, int timeout_ms) {
+  return gang_client_connect2(host, port, rank, addr, timeout_ms, nullptr);
 }
 
 // 0 = released, 1 = gang failure (a member died), -1 = io error.

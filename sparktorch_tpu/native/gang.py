@@ -45,6 +45,11 @@ def _lib():
     lib.gang_client_connect.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.gang_client_connect2.restype = ctypes.c_void_p
+    lib.gang_client_connect2.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
     lib.gang_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.gang_client_heartbeat.argtypes = [ctypes.c_void_p]
     lib.gang_client_world.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
@@ -97,8 +102,9 @@ class GangWorker:
                  timeout_ms: int = 30_000, heartbeat_interval_s: float = 2.0):
         self._lib = _lib()
         self.rank = rank
-        # Kept for heartbeat-socket reconnection (re-REG is idempotent
-        # server-side: members[rank] is overwritten, gang.cpp:104-110).
+        # Kept for heartbeat-socket reconnection (re-REG overwrites
+        # members[rank] server-side while the gang is healthy; once the
+        # gang has failed the coordinator refuses with DEAD).
         self._endpoint = (host, port, address, timeout_ms)
         self._handle = self._lib.gang_client_connect(
             host.encode(), port, rank, address.encode(), timeout_ms
@@ -107,10 +113,18 @@ class GangWorker:
             raise GangFailure(f"rank {rank}: cannot register with {host}:{port}")
         # Separate connection for heartbeats: the main connection can
         # be parked inside a blocking barrier read, and interleaving
-        # HB traffic on the same socket would steal its GO line.
+        # HB traffic on the same socket would steal its GO line. A
+        # worker without a working heartbeat channel has no failure
+        # detection at all — refuse to construct rather than run blind.
         self._hb_handle = self._lib.gang_client_connect(
             host.encode(), port, rank, address.encode(), timeout_ms
         )
+        if not self._hb_handle:
+            self._lib.gang_client_close(self._handle)
+            self._handle = None
+            raise GangFailure(
+                f"rank {rank}: heartbeat channel to {host}:{port} refused"
+            )
         self._hb_lock = threading.Lock()
         self._hb_stop = threading.Event()
         self._hb_dead = threading.Event()
@@ -147,12 +161,20 @@ class GangWorker:
                 # Dial OUTSIDE the lock (close() must never wait on a
                 # connect) and with a short timeout — this is a quick
                 # probe, not first registration; a failed dial just
-                # spends one of the remaining strikes.
+                # spends one of the remaining strikes. A DEAD reply on
+                # the re-REG is authoritative (the coordinator now
+                # refuses to resurrect a slot in a failed gang): stop
+                # probing and declare the gang lost immediately.
                 host, port, address, timeout_ms = self._endpoint
-                fresh = self._lib.gang_client_connect(
+                status = ctypes.c_int(-1)
+                fresh = self._lib.gang_client_connect2(
                     host.encode(), port, self.rank,
                     address.encode(), min(timeout_ms, 2000),
+                    ctypes.byref(status),
                 ) or None
+                if status.value == 1:
+                    self._hb_dead.set()
+                    return
                 with self._hb_lock:
                     if self._hb_handle is None:  # close()d meanwhile
                         if fresh:

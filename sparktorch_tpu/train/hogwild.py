@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
+from sparktorch_tpu.train.step import _sown_total
 from sparktorch_tpu.train.sync import TrainResult, _as_batch
 from sparktorch_tpu.utils.data import DataBatch
 from sparktorch_tpu.utils.serde import deserialize_model
@@ -158,11 +159,16 @@ def make_grad_step(apply_fn, loss_fn, mini_batch: Optional[int] = None):
 
         def weighted(params):
             variables = {"params": params, **(model_state or {})}
-            preds = apply_fn(variables, batch.x)
+            # Request the write-only 'losses' collection so sown aux
+            # objectives (MoE load-balance) train here too — the async
+            # router must optimize the same objective as the sync one.
+            preds, sown_state = apply_fn(variables, batch.x,
+                                         mutable=["losses"])
             per = loss_fn(preds, batch.y)
             num = jnp.sum(per * batch.w)
             den = jnp.maximum(jnp.sum(batch.w), 1.0)
-            return num / den
+            sown = dict(sown_state).get("losses", None)
+            return num / den + _sown_total(sown, per.dtype)
 
         loss, grads = jax.value_and_grad(weighted)(params)
         return grads, loss

@@ -72,9 +72,9 @@ def _split_variables(variables) -> Tuple[Any, Any]:
     params = variables.pop("params", variables)
     # 'losses' is a write-only collection (sown aux objectives, e.g.
     # the MoE load-balance loss); carrying it would make sow() append
-    # to it every step and grow the pytree. The sharded trainer
-    # re-requests it via `mutable` each step; the DP trainer ignores
-    # it (sow is a no-op when the collection isn't mutable).
+    # to it every step and grow the pytree. Every trainer re-requests
+    # it via `mutable` each training forward (_forward above;
+    # sharded.py does the same) and adds it to the objective.
     variables.pop("losses", None)
     return params, variables
 
@@ -99,14 +99,34 @@ def create_train_state(
 
 
 def _forward(apply_fn, params, model_state, x, train: bool):
-    """Apply with mutable non-trainable collections when present."""
+    """Apply with mutable non-trainable collections when present.
+
+    Training forwards also request the write-only ``losses`` collection
+    so sown auxiliary objectives (e.g. the MoE load-balance loss) reach
+    the caller; it is popped — never carried — because ``sow`` appends
+    to carried-in collections. Returns ``(preds, new_model_state,
+    sown_losses_or_None)``.
+    """
     variables = {"params": params, **model_state}
-    if model_state and train:
-        mutable = list(model_state.keys())
+    if train:
+        mutable = [*model_state.keys(), "losses"]
         preds, new_state = apply_fn(variables, x, mutable=mutable)
-        return preds, new_state
+        new_state = dict(new_state)
+        sown = new_state.pop("losses", None)
+        if not model_state:
+            new_state = model_state
+        return preds, new_state, sown
     preds = apply_fn(variables, x)
-    return preds, model_state
+    return preds, model_state, None
+
+
+def _sown_total(sown, dtype) -> jax.Array:
+    """Sum every sown aux-loss leaf into one scalar (0 when none)."""
+    total = jnp.zeros((), dtype)
+    if sown is not None:
+        for leaf in jax.tree.leaves(sown):
+            total = total + jnp.sum(leaf).astype(dtype)
+    return total
 
 
 def make_train_step(
@@ -151,12 +171,16 @@ def make_train_step(
             mb = batch
 
         def weighted_sums(params):
-            preds, new_model_state = _forward(
+            preds, new_model_state, sown = _forward(
                 apply_fn, params, state.model_state, mb.x, train=True
             )
             per = loss_fn(preds, mb.y)
-            num = jnp.sum(per * mb.w)
             den = jnp.sum(mb.w)
+            # Sown aux objectives (per-shard means, pre-weighted at the
+            # sow site) scale by den so the global psum(num)/psum(den)
+            # is the task mean plus the example-weighted mean aux —
+            # matching the sharded trainer's objective.
+            num = jnp.sum(per * mb.w) + _sown_total(sown, per.dtype) * den
             return num, (den, new_model_state)
 
         (num, (den, new_model_state)), grads_num = jax.value_and_grad(
@@ -237,11 +261,13 @@ def make_train_epoch(
                 mb = batch
 
             def weighted_sums(params):
-                preds, new_model_state = _forward(
+                preds, new_model_state, sown = _forward(
                     apply_fn, params, state.model_state, mb.x, train=True
                 )
                 per = loss_fn(preds, mb.y)
-                return jnp.sum(per * mb.w), (jnp.sum(mb.w), new_model_state)
+                den = jnp.sum(mb.w)
+                num = jnp.sum(per * mb.w) + _sown_total(sown, per.dtype) * den
+                return num, (den, new_model_state)
 
             (num, (den, new_model_state)), grads_num = jax.value_and_grad(
                 weighted_sums, has_aux=True
@@ -294,7 +320,7 @@ def make_eval_step(
     forward of ``distributed.py:166-176``, compiled and collective."""
 
     def shard_eval(state: TrainState, batch: DataBatch):
-        preds, _ = _forward(
+        preds, _, _ = _forward(
             apply_fn, state.params, state.model_state, batch.x, train=False
         )
         per = loss_fn(preds, batch.y)

@@ -558,6 +558,10 @@ def train_distributed_streaming(
             starts = list(range(0, n, chunk_rows))
             resident = put_chunk(starts[0], order)
             for ci, lo in enumerate(starts):
+                # Per-chunk liveness, matching train_distributed: a
+                # peer host dying mid-epoch must abort before the next
+                # compiled dispatch, not at the epoch boundary.
+                check_gang()
                 t0 = time.perf_counter()
                 state, metrics = step_fn(state, resident)
                 # Enqueue the NEXT chunk's host->device copy while the

@@ -21,6 +21,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Nearly all of the suite's wall-time is XLA recompilation of the same
+# jitted steps run-over-run; a persistent on-disk cache makes the warm
+# suite several times faster. Deliberately a different directory from
+# bench.py's TPU-side cache; within it, JAX's own cache keys (which
+# include topology/backend) keep entries from colliding.
+_CACHE_DIR = os.environ.get("SPARKTORCH_TPU_TEST_CACHE", "/tmp/sparktorch_tpu_test_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np
 import pytest
 

@@ -217,6 +217,27 @@ def test_gang_stop_releases_barrier_with_error():
     w0.close()
 
 
+def test_gang_reg_rejected_after_failure():
+    # Once the gang is marked failed, re-registration must NOT
+    # resurrect the dead slot (which would mask the gang-wide DEAD
+    # verdict peers already saw) — the coordinator refuses with DEAD
+    # and the dialer fails.
+    with GangCoordinator(world_size=2, heartbeat_timeout_ms=300) as coord:
+        w0 = GangWorker("127.0.0.1", coord.port, 0, "a:1",
+                        heartbeat_interval_s=0.1)
+        w1 = GangWorker("127.0.0.1", coord.port, 1, "b:1",
+                        heartbeat_interval_s=0.1)
+        w1.suspend_heartbeat()
+        deadline = time.time() + 10
+        while not coord.failed and time.time() < deadline:
+            time.sleep(0.05)
+        assert coord.failed
+        with pytest.raises(GangFailure):
+            GangWorker("127.0.0.1", coord.port, 1, "b:1")
+        w0.close()
+        w1.close()
+
+
 def test_trainer_aborts_when_peer_host_dies():
     # Trainer-level failure path: a multi-host run where a PEER host
     # dies mid-training. The survivor's training loop polls the gang
