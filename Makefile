@@ -4,7 +4,8 @@
 
 PYTHON ?= python
 
-.PHONY: install test test-fast native bench bench-all clean
+.PHONY: install test test-fast test-pyspark native bench bench-all \
+	cluster-up clean
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -14,6 +15,19 @@ test:
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+# Real pyspark + JVM persistence harness (skips without pyspark/java;
+# `pip install -e .[spark]` + a JRE make it run for real). Own process
+# so the localspark shim never shadows genuine pyspark.
+test-pyspark:
+	$(PYTHON) -m pytest tests/test_real_pyspark.py -v
+
+# Genuine Spark standalone cluster (master+worker+driver) running the
+# adapter example and the JVM persistence tests. Reference parity:
+# docker-compose.yml:3-25.
+cluster-up:
+	docker compose -f deploy/docker/docker-compose.yml up --build \
+		--abort-on-container-exit --exit-code-from driver
 
 # Build the native C++ runtime (gang coordinator, rowpack parser)
 # explicitly; tests otherwise build it on first use.

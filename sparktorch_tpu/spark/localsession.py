@@ -468,7 +468,153 @@ class Broadcast:
         pass
 
 
+class _JavaArray(list):
+    """Fixed-length array as py4j's ``gateway.new_array`` returns —
+    supports the slice assignment the carrier encoder uses
+    (reference ``pipeline_util.py:125``)."""
+
+    def __init__(self, n: int):
+        super().__init__([None] * n)
+
+
+class _JavaString:
+    """Token standing in for ``gateway.jvm.java.lang.String``."""
+
+
+class _JavaLang:
+    String = _JavaString
+
+
+class _Java:
+    lang = _JavaLang
+
+
+class _Jvm:
+    java = _Java
+
+
+class _Gateway:
+    """The slice of the py4j gateway surface the carrier encoder
+    touches (``sc._gateway.jvm.java.lang.String`` +
+    ``sc._gateway.new_array``). With real pyspark these calls cross
+    into the JVM; here they hit this protocol-faithful local stand-in,
+    so the SAME ``_to_java`` code path executes in both runtimes."""
+
+    jvm = _Jvm
+
+    def new_array(self, java_class, n: int) -> _JavaArray:
+        return _JavaArray(n)
+
+
+class _JavaStopWordsRemover:
+    """The object ``JavaParams._new_java_obj`` would materialize in the
+    JVM (``org.apache.spark.ml.feature.StopWordsRemover``): carries a
+    uid and a stopwords array."""
+
+    def __init__(self, uid: str):
+        self._uid = uid
+        self._stopWords: list = []
+
+    def setStopWords(self, arr):
+        self._stopWords = [w for w in arr]
+        return self
+
+    def getStopWords(self):
+        return list(self._stopWords)
+
+    def uid(self):
+        return self._uid
+
+
+class JavaParams:
+    """pyspark.ml.wrapper.JavaParams subset: the ``_new_java_obj``
+    factory the carrier encoder calls (reference
+    ``pipeline_util.py:126``)."""
+
+    _CARRIER_JAVA_CLASS = "org.apache.spark.ml.feature.StopWordsRemover"
+
+    @staticmethod
+    def _new_java_obj(java_class: str, *args):
+        if java_class != JavaParams._CARRIER_JAVA_CLASS:
+            raise ValueError(
+                f"localspark gateway only materializes the carrier class, "
+                f"not {java_class!r}"
+            )
+        uid = args[0] if args else f"StopWordsRemover_{uuid.uuid4().hex[:12]}"
+        return _JavaStopWordsRemover(uid)
+
+
+class JavaMLWriter:
+    """Stage-level writer driving the instance's ``_to_java`` hook —
+    the same contract as pyspark's JavaMLWriter (which the reference
+    returns from ``write()``, ``pipeline_util.py:88-90``): convert to
+    the JVM-persistable carrier, save it under ``path``."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "JavaMLWriter":
+        self._overwrite = True
+        return self
+
+    def session(self, _session) -> "JavaMLWriter":
+        return self
+
+    def save(self, path: str) -> None:
+        jobj = self._instance._to_java()
+        if os.path.exists(path) and not self._overwrite:
+            raise FileExistsError(path)
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "class": JavaParams._CARRIER_JAVA_CLASS,
+            "uid": jobj.uid(),
+            "stopWords": jobj.getStopWords(),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+
+class JavaMLReader:
+    """Reads a saved carrier stage back as the carrier class instance
+    (pyspark's ``JavaMLReader(StopWordsRemover).load`` contract — the
+    reference's ``read()``, ``pipeline_util.py:92-95``)."""
+
+    def __init__(self, clazz):
+        self._clazz = clazz
+
+    def load(self, path: str):
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        if meta.get("class") != JavaParams._CARRIER_JAVA_CLASS:
+            raise ValueError(f"not a carrier stage dir: {path}")
+        stage = self._clazz()
+        stage.uid = meta["uid"]
+        stage.setStopWords(meta["stopWords"])
+        return stage
+
+
+class MLReadable:
+    """Marker mixin, parity with pyspark.ml.util.MLReadable."""
+
+
+class MLWritable:
+    """Marker mixin, parity with pyspark.ml.util.MLWritable."""
+
+
+class Identifiable:
+    """Marker mixin, parity with pyspark.ml.util.Identifiable."""
+
+
 class SparkContext:
+    # Real pyspark exposes the active context (and its py4j gateway)
+    # here; the carrier encoder reads it (reference
+    # pipeline_util.py:120). Set while a SparkSession is alive.
+    _active_spark_context: Optional["SparkContext"] = None
+
+    def __init__(self):
+        self._gateway = _Gateway()
+
     def broadcast(self, value) -> Broadcast:
         return Broadcast(value)
 
@@ -490,6 +636,7 @@ class SparkSession:
     def __init__(self, master: str = "local[2]"):
         self.conf = _RuntimeConf()
         self.sparkContext = SparkContext()
+        SparkContext._active_spark_context = self.sparkContext
         m = re.match(r"local\[(\d+|\*)\]", master or "local[2]")
         self.default_parallelism = (
             os.cpu_count() if m and m.group(1) == "*" else int(m.group(1)) if m else 2
@@ -536,6 +683,7 @@ class SparkSession:
 
     def stop(self):
         SparkSession._active = None
+        SparkContext._active_spark_context = None
 
 
 class _BuilderDescriptor:
@@ -814,5 +962,12 @@ def install(force: bool = False) -> bool:
         VectorUDT=VectorUDT,
     )
     ml.functions = module("pyspark.ml.functions", vector_to_array=vector_to_array)
-    ml.util = module("pyspark.ml.util")
+    ml.util = module(
+        "pyspark.ml.util",
+        JavaMLWriter=JavaMLWriter, JavaMLReader=JavaMLReader,
+        MLReadable=MLReadable, MLWritable=MLWritable,
+        Identifiable=Identifiable,
+    )
+    ml.wrapper = module("pyspark.ml.wrapper", JavaParams=JavaParams)
+    pyspark.context = module("pyspark.context", SparkContext=SparkContext)
     return True

@@ -23,9 +23,12 @@ from typing import Any, List
 import dill
 
 try:
+    from pyspark.context import SparkContext
     from pyspark.ml import Pipeline as SparkPipeline
     from pyspark.ml import PipelineModel as SparkPipelineModel
     from pyspark.ml.feature import StopWordsRemover
+    from pyspark.ml.util import JavaMLReader, JavaMLWriter
+    from pyspark.ml.wrapper import JavaParams
 except ImportError as _e:  # pragma: no cover - exercised only w/ pyspark
     raise ImportError(
         "sparktorch_tpu.spark requires pyspark; install it or use the "
@@ -38,15 +41,20 @@ except ImportError as _e:  # pragma: no cover - exercised only w/ pyspark
 CARRIER_GUID = "4c1740b00d3c4ff6806a1402321572cb"
 
 
-def encode_python_stage(obj: Any, uid: str) -> StopWordsRemover:
-    """Pack a Python stage into a JVM-persistable carrier stage."""
+def _payload_strings(obj: Any) -> List[str]:
+    """dill -> zlib -> decimal-rendered bytes, GUID-tagged — the
+    2-element stopwords list that IS the carrier file format."""
     payload = zlib.compress(dill.dumps(obj))
     # Trailing comma matters: the reference's reader does
     # ``split(',')[0:-1]`` (pipeline_util.py:35), so a string without
     # it would lose its last byte there.
-    as_decimal = "".join(f"{b}," for b in payload)
+    return ["".join(f"{b}," for b in payload), CARRIER_GUID]
+
+
+def encode_python_stage(obj: Any, uid: str) -> StopWordsRemover:
+    """Pack a Python stage into a JVM-persistable carrier stage."""
     carrier = StopWordsRemover(inputCol=uid, outputCol=uid + "_out")
-    carrier.setStopWords([as_decimal, CARRIER_GUID])
+    carrier.setStopWords(_payload_strings(obj))
     return carrier
 
 
@@ -65,34 +73,79 @@ def is_carrier(stage) -> bool:
 
 
 class PythonStagePersistence:
-    """Mixin that lets a pure-Python pyspark stage survive
-    ``Pipeline.write().save(path)`` / ``PipelineModel.load(path)``.
+    """Mixin that lets a pure-Python pyspark stage (estimator, model,
+    or transformer) be saved and loaded — directly via
+    ``stage.write().save(path)`` / ``Cls.load(path)``, or inside a
+    surrounding ``Pipeline``/``PipelineModel``.
 
     Parity: the reference's ``PysparkReaderWriter`` (reference
-    ``pipeline_util.py:80-130``) — when the surrounding pipeline is
-    persisted, the stage converts itself into the JVM-persistable
-    carrier (a ``StopWordsRemover`` whose stopwords smuggle the dill
-    payload, tagged with the magic GUID); loading + ``unwrap`` (below)
-    restores the live Python object.
+    ``pipeline_util.py:80-130``), mixed into BOTH the estimator and
+    the model (reference ``torch_distributed.py:58,130-138``):
 
-    Two hooks cover both runtimes: real pyspark's ``JavaMLWriter``
-    calls ``_to_java`` (we build a real StopWordsRemover and delegate
-    to its own ``_to_java``); the localspark runtime's pipeline writer
-    calls ``_to_carrier``.
+    - ``write()`` returns the runtime's ``JavaMLWriter`` over this
+      instance, whose save path calls ``_to_java`` (reference :88-90);
+    - ``read()``/``load()`` go through ``JavaMLReader`` on the carrier
+      class and re-hydrate with ``_from_java`` (reference :92-101);
+    - ``_to_java`` performs the gateway-side carrier construction
+      itself — dill dump, zlib, decimal string array through
+      ``sc._gateway.new_array``, ``JavaParams._new_java_obj`` of the
+      carrier class (reference :112-130). Under real pyspark these
+      calls cross the Py4J bridge into the JVM; under localspark they
+      hit the protocol-faithful local gateway — the same code path
+      either way.
+
+    ``_to_carrier`` additionally serves the localspark pipeline
+    writer, which persists carrier stages as JSON param maps.
     """
 
-    def _to_carrier(self):
-        return encode_python_stage(self, getattr(self, "uid", "pystage"))
-
-    def _to_java(self):  # pragma: no cover - needs a JVM gateway
-        return self._to_carrier()._to_java()
+    def write(self) -> "JavaMLWriter":
+        return JavaMLWriter(self)
 
     @classmethod
-    def _from_java(cls, java_stage):  # pragma: no cover - needs a JVM
-        py_carrier = StopWordsRemover()
-        py_carrier._java_obj = java_stage
-        py_carrier._transfer_params_from_java()
-        return decode_carrier_stage(py_carrier)
+    def read(cls) -> "JavaMLReader":
+        return JavaMLReader(StopWordsRemover)
+
+    @classmethod
+    def load(cls, path: str):
+        obj = cls._from_java(cls.read().load(path))
+        # The carrier format has no class discriminator; catch a
+        # wrong-kind load (model path through SparkTorch.load, etc.)
+        # here rather than as a far-away AttributeError.
+        if cls is not PythonStagePersistence and not isinstance(obj, cls):
+            raise TypeError(
+                f"{path} holds a {type(obj).__name__}, not a {cls.__name__}"
+            )
+        return obj
+
+    def _to_carrier(self) -> StopWordsRemover:
+        return encode_python_stage(self, getattr(self, "uid", "pystage"))
+
+    def _to_java(self):
+        pylist = _payload_strings(self)
+        sc = SparkContext._active_spark_context
+        if sc is None:
+            raise RuntimeError(
+                "persistence requires an active SparkSession (the "
+                "gateway lives on SparkContext._active_spark_context)"
+            )
+        java_class = sc._gateway.jvm.java.lang.String
+        java_array = sc._gateway.new_array(java_class, len(pylist))
+        java_array[0:2] = pylist[0:2]
+        java_obj = JavaParams._new_java_obj(
+            "org.apache.spark.ml.feature.StopWordsRemover",
+            getattr(self, "uid", "pystage"),
+        )
+        java_obj.setStopWords(java_array)
+        return java_obj
+
+    @classmethod
+    def _from_java(cls, java_stage):
+        """Carrier (JVM object via Py4J, or any object exposing
+        ``getStopWords``) -> live Python instance."""
+        words = list(java_stage.getStopWords())
+        if not words or words[-1] != CARRIER_GUID:
+            raise ValueError("stage is not a sparktorch carrier")
+        return decode_carrier_stage(java_stage)
 
 
 def unwrap_spark_pipeline(pipeline):

@@ -41,6 +41,7 @@ try:
     from pyspark.ml.base import Estimator, Model
     from pyspark.ml.param import Param, Params, TypeConverters
     from pyspark.ml.param.shared import HasInputCol, HasLabelCol, HasPredictionCol
+    from pyspark.ml.util import MLReadable, MLWritable
     from pyspark.sql.functions import pandas_udf
     from pyspark.sql.types import ArrayType, DoubleType
 except ImportError as _e:  # pragma: no cover
@@ -51,6 +52,7 @@ except ImportError as _e:  # pragma: no cover
 
 
 from sparktorch_tpu.ml.estimator import _decode_bundle, _encode_bundle
+from sparktorch_tpu.spark.pipeline_util import PythonStagePersistence
 from sparktorch_tpu.utils.serde import deserialize_model
 
 
@@ -109,7 +111,16 @@ class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
                        typeConverter=TypeConverters.toString)
 
 
-class SparkTorch(Estimator, _SparkTorchParams):
+class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
+                 MLReadable, MLWritable):
+    """Persistence is mixed into the ESTIMATOR too (reference
+    ``torch_distributed.py:130-138``): an *unfitted* Pipeline holding
+    a SparkTorch stage saves/loads, and the stage saves directly via
+    ``write()``/``load()``. ``MLReadable``/``MLWritable`` mark the
+    stage persistable to pyspark's Pipeline writer (the reference
+    mixes them the same way); ``PythonStagePersistence`` precedes them
+    in the MRO so its concrete ``write``/``read``/``load`` win."""
+
     @keyword_only
     def __init__(self, inputCol=None, labelCol=None, predictionCol=None,
                  torchObj=None, iters=None, partitions=None, verbose=None,
@@ -444,10 +455,8 @@ class SparkTorch(Estimator, _SparkTorchParams):
         return out[0]
 
 
-from sparktorch_tpu.spark.pipeline_util import PythonStagePersistence
-
-
-class SparkTorchModel(Model, _SparkTorchParams, PythonStagePersistence):
+class SparkTorchModel(Model, _SparkTorchParams, PythonStagePersistence,
+                      MLReadable, MLWritable):
     """Fitted transformer. Persists inside standard Spark pipelines via
     the carrier mechanism (PythonStagePersistence — the writer hook the
     reference implements in ``pipeline_util.py:80-130``)."""

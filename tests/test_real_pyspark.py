@@ -1,0 +1,168 @@
+"""Real pyspark + JVM persistence harness.
+
+These tests exercise the flagship deployment claim against GENUINE
+pyspark — a ``local[2]`` session with a live JVM and Py4J gateway —
+so ``_to_java``/``_from_java`` cross the real gateway into scala
+``StopWordsRemover`` objects (the reference's actual mechanism,
+reference ``pipeline_util.py:112-130``), not the localspark
+protocol stand-in.
+
+They SKIP (not pass vacuously) when real pyspark or a JVM is absent:
+this repo's default test image has neither, so the suite stays green
+there, while ``make test-pyspark`` / the CI ``pyspark`` job / the
+``deploy/`` docker harness run them for real.
+
+Run order matters: this module must come before any test that calls
+``localsession.install()`` in the same process, or "pyspark" in
+``sys.modules`` would be the shim. A dedicated process (the make
+target / CI job runs ONLY this file) sidesteps that entirely.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+if getattr(pyspark, "__localspark__", False):  # pragma: no cover
+    pytest.skip("localspark shim installed; these tests need real pyspark",
+                allow_module_level=True)
+if shutil.which("java") is None:  # pragma: no cover
+    pytest.skip("no JVM on PATH", allow_module_level=True)
+
+from pyspark.ml import Pipeline, PipelineModel  # noqa: E402
+from pyspark.ml.linalg import Vectors  # noqa: E402
+from pyspark.sql import SparkSession  # noqa: E402
+
+from sparktorch_tpu.models import Net  # noqa: E402
+from sparktorch_tpu.spark.pipeline_util import (  # noqa: E402
+    CARRIER_GUID,
+    PysparkPipelineWrapper,
+    PythonStagePersistence,
+    is_carrier,
+)
+from sparktorch_tpu.spark.torch_distributed import (  # noqa: E402
+    SparkTorch,
+    SparkTorchModel,
+)
+from sparktorch_tpu.utils.serde import serialize_model  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def spark():
+    s = (
+        SparkSession.builder.master("local[2]")
+        .appName("sparktorch_tpu-real-pyspark")
+        .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+        .getOrCreate()
+    )
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def data(spark):
+    """The reference's fixture dataset: two Gaussian blobs as
+    (label, DenseVector) rows, 2 partitions (reference
+    tests/test_sparktorch.py:21-26)."""
+    rng = np.random.default_rng(42)
+    x0 = rng.normal(0.0, 1.0, (100, 10))
+    x1 = rng.normal(2.0, 1.0, (100, 10))
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(100), np.ones(100)])
+    perm = rng.permutation(200)
+    rows = [(float(y[i]), Vectors.dense(x[i].tolist())) for i in perm]
+    return spark.createDataFrame(rows, ["label", "features"]).repartition(2)
+
+
+def _estimator(**overrides):
+    payload = serialize_model(
+        Net(), "mse", "adam", {"lr": 1e-2}, input_shape=(10,)
+    )
+    kwargs = dict(
+        inputCol="features", labelCol="label", predictionCol="predictions",
+        torchObj=payload, iters=25, verbose=0,
+    )
+    kwargs.update(overrides)
+    return SparkTorch(**kwargs)
+
+
+def _preds(df):
+    return np.asarray([r["predictions"] for r in df.collect()])
+
+
+def test_fit_transform_real_spark(data):
+    model = _estimator().fit(data)
+    assert isinstance(model, SparkTorchModel)
+    res = model.transform(data)
+    preds = _preds(res)
+    labels = np.asarray([r["label"] for r in data.collect()])
+    assert np.mean((preds > 0.5) == (labels > 0.5)) > 0.9
+
+
+def test_fitted_pipeline_jvm_round_trip(data, tmp_path):
+    """Fitted PipelineModel through JavaMLWriter/_to_java into the
+    real JVM, loaded back, unwrapped, transform equality — the
+    reference's README flow (README.md:174-183)."""
+    fitted = Pipeline(stages=[_estimator()]).fit(data)
+    path = str(tmp_path / "fitted_pipe")
+    fitted.write().overwrite().save(path)
+
+    loaded_raw = PipelineModel.load(path)
+    assert is_carrier(loaded_raw.stages[0])
+    assert loaded_raw.stages[0].getStopWords()[-1] == CARRIER_GUID
+    loaded = PysparkPipelineWrapper.unwrap(loaded_raw)
+    assert isinstance(loaded.stages[0], SparkTorchModel)
+    np.testing.assert_array_equal(
+        _preds(fitted.transform(data)), _preds(loaded.transform(data))
+    )
+
+
+def test_unfitted_pipeline_jvm_round_trip(data, tmp_path):
+    """Unfitted Pipeline holding the ESTIMATOR saves/loads through the
+    JVM (the estimator-side persistence the reference attaches at
+    torch_distributed.py:130-138); the re-hydrated estimator fits."""
+    pipe = Pipeline(stages=[_estimator(iters=15)])
+    path = str(tmp_path / "unfitted_pipe")
+    pipe.write().overwrite().save(path)
+
+    loaded = PysparkPipelineWrapper.unwrap(Pipeline.load(path))
+    est = loaded.getStages()[0]
+    assert isinstance(est, SparkTorch)
+    assert est.getOrDefault(est.iters) == 15
+    model = loaded.fit(data)
+    preds = _preds(model.transform(data))
+    labels = np.asarray([r["label"] for r in data.collect()])
+    assert np.mean((preds > 0.5) == (labels > 0.5)) > 0.85
+
+
+def test_direct_stage_write_load_jvm(data, tmp_path):
+    """Direct stage-level write()/read()/load() against the JVM
+    (reference pipeline_util.py:88-101)."""
+    est = _estimator(iters=20)
+    epath = str(tmp_path / "est")
+    est.write().overwrite().save(epath)
+    loaded_est = SparkTorch.load(epath)
+    assert loaded_est.getOrDefault(loaded_est.iters) == 20
+
+    model = loaded_est.fit(data)
+    mpath = str(tmp_path / "model")
+    model.write().overwrite().save(mpath)
+    loaded_model = SparkTorchModel.load(mpath)
+    np.testing.assert_array_equal(
+        _preds(model.transform(data)), _preds(loaded_model.transform(data))
+    )
+
+
+def test_to_java_real_gateway(data):
+    """_to_java/_from_java round trip across the LIVE Py4J gateway —
+    the leg localspark can only emulate."""
+    est = _estimator(iters=9)
+    jobj = est._to_java()
+    # A genuine JVM object, not a Python shim.
+    assert type(jobj).__module__.startswith("py4j")
+    words = list(jobj.getStopWords())
+    assert words[-1] == CARRIER_GUID
+    back = PythonStagePersistence._from_java(jobj)
+    assert isinstance(back, SparkTorch)
+    assert back.getOrDefault(back.iters) == 9
