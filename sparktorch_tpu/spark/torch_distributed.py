@@ -109,6 +109,14 @@ class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
                           typeConverter=TypeConverters.toFloat)
     deployMode = Param(Params._dummy(), "deployMode", "driver | barrier",
                        typeConverter=TypeConverters.toString)
+    pushEvery = Param(Params._dummy(), "pushEvery",
+                      "hogwild: fuse k grad steps into one compiled window "
+                      "per push (k-fold fewer wire round-trips; the window "
+                      "is the staleness unit)",
+                      typeConverter=TypeConverters.toInt)
+    compress = Param(Params._dummy(), "compress",
+                     "hogwild: bf16-compress gradient pushes on the wire",
+                     typeConverter=TypeConverters.toBoolean)
 
 
 class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
@@ -127,14 +135,15 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                  mode=None, device=None, acquireLock=None,
                  partitionShuffles=None, port=None, useBarrier=None,
                  useVectorOut=None, earlyStopPatience=None, miniBatch=None,
-                 validationPct=None, deployMode=None):
+                 validationPct=None, deployMode=None, pushEvery=None,
+                 compress=None):
         super().__init__()
         self._setDefault(
             predictionCol="predictions", mode="synchronous", device="tpu",
             iters=10, verbose=0, acquireLock=True, partitionShuffles=1,
             port=3000, useBarrier=True, useVectorOut=False,
             earlyStopPatience=-1, miniBatch=-1, validationPct=0.0,
-            deployMode="driver",
+            deployMode="driver", pushEvery=1, compress=True,
         )
         self._set(**self._input_kwargs)
 
@@ -193,6 +202,8 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                 port=self.getOrDefault(self.port),
                 partitions=self.getOrDefault(self.partitions)
                 if self.isDefined(self.partitions) else -1,
+                push_every=self.getOrDefault(self.pushEvery),
+                compress=self.getOrDefault(self.compress),
             )
         else:
             from sparktorch_tpu.train.sync import train_distributed
@@ -231,6 +242,8 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
         # otherwise ephemeral, so concurrent fits never collide.
         port = self.getOrDefault(self.port) if self.isSet(self.port) else 0
         lock = self.getOrDefault(self.acquireLock)
+        push_every = max(1, self.getOrDefault(self.pushEvery))
+        compress = self.getOrDefault(self.compress)
         spark = dataset.sparkSession
         driver_host = spark.conf.get("spark.driver.host", "127.0.0.1")
         n_parts = (self.getOrDefault(self.partitions)
@@ -277,13 +290,14 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                     _worker_loop,
                     make_eval_loss,
                     make_grad_step,
+                    make_grad_windows,
                 )
                 from sparktorch_tpu.utils.data import handle_features
                 from sparktorch_tpu.utils.serde import (
                     deserialize_model as _deserialize,
                 )
 
-                transport = HttpTransport(url)
+                transport = HttpTransport(url, compress=compress)
                 assert transport.alive()  # GET / liveness (hogwild.py:60-62)
                 w_spec = _deserialize(torch_obj)
                 x = _rows_to_x(rows)
@@ -308,6 +322,13 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                 module = w_spec.make_module()
                 grad_step = make_grad_step(module.apply, w_spec.loss_fn(),
                                            mini_batch=mini_batch)
+                # pushEvery=k: one compiled k-step window per wire
+                # round-trip — the amortization built for exactly this
+                # deployment (executors over real HTTP).
+                grad_windows = make_grad_windows(
+                    module.apply, w_spec.loss_fn(), mini_batch, push_every,
+                    iters,
+                )
                 eval_loss = (
                     make_eval_loss(module.apply, w_spec.loss_fn())
                     if val_shard is not None else None
@@ -321,7 +342,8 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                     _jax.device_put(val_shard, _jax.devices()[0])
                     if val_shard is not None else None,
                     iters, verbose, early_stop, round_seed,
-                    records, errors, eval_loss=eval_loss,
+                    records, errors, push_every=push_every,
+                    eval_loss=eval_loss, grad_windows=grad_windows,
                 )
                 if errors:
                     raise errors[0]
@@ -353,9 +375,12 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                 )
                 if server.should_stop:
                     break
-            # Introspection hook for callers/tests (per-worker loss and
-            # observed-version traces).
+            # Introspection hooks for callers/tests (per-worker loss and
+            # observed-version traces; server-side applied-push count —
+            # with pushEvery=k this is ~iters/k per worker, the proof
+            # the wire carried window-sized pushes).
             self._last_hogwild_summaries = summaries
+            self._last_hogwild_applied = server.applied_updates
             params, model_state = server.final_state()
             import jax as _jax
 

@@ -200,6 +200,24 @@ def make_grad_window(apply_fn, loss_fn, mini_batch: Optional[int], k: int):
     return grad_window
 
 
+def make_grad_windows(apply_fn, loss_fn, mini_batch: Optional[int],
+                      push_every: int, iters: int):
+    """Build the ``(full_window, tail_window)`` pair ``_worker_loop``
+    expects for ``push_every=k``: the full k-step window plus a
+    remainder window when ``iters % k != 0`` (the full window is reused
+    when the division is exact). One source of truth for the tail-
+    window contract, shared by ``train_async`` and the Spark executor
+    deployment. Returns None when ``push_every <= 1``."""
+    if not push_every or push_every <= 1:
+        return None
+    rem = iters % push_every
+    window = make_grad_window(apply_fn, loss_fn, mini_batch, push_every)
+    return (
+        window,
+        make_grad_window(apply_fn, loss_fn, mini_batch, rem) if rem else window,
+    )
+
+
 def make_eval_loss(apply_fn, loss_fn):
     """Jitted full-shard weighted loss (no grads) — the validation
     probe for early stopping."""
@@ -368,16 +386,8 @@ def train_async(
         module = spec.make_module()
         grad_step = make_grad_step(module.apply, spec.loss_fn(),
                                    mini_batch=mini_batch)
-        grad_windows = None
-        if push_every and push_every > 1:
-            rem = iters % push_every
-            window = make_grad_window(module.apply, spec.loss_fn(),
-                                      mini_batch, push_every)
-            grad_windows = (
-                window,
-                make_grad_window(module.apply, spec.loss_fn(),
-                                 mini_batch, rem) if rem else window,
-            )
+        grad_windows = make_grad_windows(module.apply, spec.loss_fn(),
+                                         mini_batch, push_every, iters)
         eval_loss = (
             make_eval_loss(module.apply, spec.loss_fn())
             if val_batch is not None else None

@@ -67,6 +67,79 @@ class StepMetrics(NamedTuple):
     grad_norm: jax.Array
 
 
+class EpochMetrics(NamedTuple):
+    """Stacked per-step metrics from a fused chunk with early-stop /
+    validation support. ``val_loss`` is NaN when no val batch was given;
+    ``active`` is False for steps masked out after the stop fired (the
+    host must ignore those rows)."""
+
+    loss: jax.Array
+    examples: jax.Array
+    grad_norm: jax.Array
+    val_loss: jax.Array
+    active: jax.Array
+
+
+class EsConfig(NamedTuple):
+    """Static early-stopping config compiled into the fused chunk.
+    Field semantics match :class:`~sparktorch_tpu.utils.early_stopper.
+    EarlyStopping` (itself mirroring ``early_stopper.py:8-56``)."""
+
+    mode: str = "min"
+    min_delta: float = 0.0
+    patience: int = 10
+    percentage: bool = False
+
+
+class EsState(NamedTuple):
+    """Device-resident early-stopper carry (the jax translation of the
+    host ``EarlyStopping`` object's mutable fields, so the stop decision
+    can be made INSIDE the fused ``lax.scan`` instead of only at chunk
+    boundaries)."""
+
+    best: jax.Array         # f32; valid once `initialized`
+    num_bad: jax.Array      # i32
+    stopped: jax.Array      # bool — latches
+    initialized: jax.Array  # bool — False before the first signal
+
+
+def init_es_state() -> EsState:
+    return EsState(
+        best=jnp.zeros((), jnp.float32),
+        num_bad=jnp.zeros((), jnp.int32),
+        stopped=jnp.zeros((), jnp.bool_),
+        initialized=jnp.zeros((), jnp.bool_),
+    )
+
+
+def _es_update(cfg: EsConfig, es: EsState, signal: jax.Array) -> EsState:
+    """One ``EarlyStopping.step`` in jax ops. Exact host semantics:
+    first signal only seeds ``best``; NaN after that stops; otherwise
+    patience counting with abs/pct delta in min/max mode."""
+    signal = signal.astype(jnp.float32)
+    first = ~es.initialized
+    if cfg.percentage:
+        delta = jnp.abs(es.best) * (cfg.min_delta / 100.0)
+    else:
+        delta = jnp.float32(cfg.min_delta)
+    if cfg.mode == "min":
+        better = signal < es.best - delta
+    else:
+        better = signal > es.best + delta
+    num_bad = jnp.where(better, 0, es.num_bad + 1)
+    best = jnp.where(better, signal, es.best)
+    stop_now = jnp.isnan(signal) | (num_bad >= cfg.patience)
+    best = jnp.where(first, signal, best)
+    num_bad = jnp.where(first, 0, num_bad)
+    stop_now = jnp.where(first, jnp.zeros((), jnp.bool_), stop_now)
+    return EsState(
+        best=best,
+        num_bad=num_bad,
+        stopped=es.stopped | stop_now,
+        initialized=jnp.ones((), jnp.bool_),
+    )
+
+
 def _split_variables(variables) -> Tuple[Any, Any]:
     variables = dict(variables)
     params = variables.pop("params", variables)
@@ -129,6 +202,78 @@ def _sown_total(sown, dtype) -> jax.Array:
     return total
 
 
+def _shard_index(axis_names: Tuple[str, ...]) -> jax.Array:
+    """Linearized index of this shard over the batch axes."""
+    shard_id = jnp.zeros((), jnp.int32)
+    for ax in axis_names:
+        shard_id = shard_id * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+    return shard_id
+
+
+def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
+             state: TrainState, batch: DataBatch):
+    """One DP train step, called inside shard_map. Shared by the
+    single-step, fused-epoch, and fused-with-early-stop builders.
+
+    Per-shard sampling key: replicated rng folded with the shard index —
+    data selection differs per shard, carried rng stays replicated so
+    the output state is provably identical on all shards.
+    """
+    rng, next_rng = jax.random.split(state.rng)
+    sample_key = jax.random.fold_in(rng, _shard_index(axis_names))
+
+    if per_shard_mb is not None and per_shard_mb < batch.x.shape[0]:
+        mb = sample_minibatch(batch, sample_key, per_shard_mb)
+    else:
+        mb = batch
+
+    def weighted_sums(params):
+        preds, new_model_state, sown = _forward(
+            apply_fn, params, state.model_state, mb.x, train=True
+        )
+        per = loss_fn(preds, mb.y)
+        den = jnp.sum(mb.w)
+        # Sown aux objectives (per-shard means, pre-weighted at the
+        # sow site) scale by den so the global psum(num)/psum(den)
+        # is the task mean plus the example-weighted mean aux —
+        # matching the sharded trainer's objective.
+        num = jnp.sum(per * mb.w) + _sown_total(sown, per.dtype) * den
+        return num, (den, new_model_state)
+
+    (num, (den, new_model_state)), grads_num = jax.value_and_grad(
+        weighted_sums, has_aux=True
+    )(state.params)
+
+    # ONE fused collective for everything the step needs globally.
+    num_g = jax.lax.psum(num, axis_names)
+    den_g = jax.lax.psum(den, axis_names)
+    grads_g = jax.lax.psum(grads_num, axis_names)
+    safe_den = jnp.maximum(den_g, 1.0)
+    grads = jax.tree.map(lambda g: g / safe_den, grads_g)
+    loss = num_g / safe_den
+
+    # Non-trainable collections (batch_stats) sync by global mean.
+    if state.model_state:
+        new_model_state = jax.tree.map(
+            lambda a: jax.lax.pmean(a, axis_names)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            new_model_state,
+        )
+    updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    gnorm = optax.global_norm(grads)
+
+    new_state = TrainState(
+        step=state.step + 1,
+        params=new_params,
+        model_state=new_model_state,
+        opt_state=new_opt_state,
+        rng=next_rng,
+    )
+    return new_state, StepMetrics(loss=loss, examples=den_g, grad_norm=gnorm)
+
+
 def make_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -155,66 +300,8 @@ def make_train_step(
         per_shard_mb = mini_batch
 
     def shard_step(state: TrainState, batch: DataBatch):
-        # Per-shard sampling key: replicated rng folded with the shard
-        # index — data selection differs per shard, carried rng stays
-        # replicated so the output state is provably identical on all
-        # shards.
-        rng, next_rng = jax.random.split(state.rng)
-        shard_id = jnp.zeros((), jnp.int32)
-        for ax in axis_names:
-            shard_id = shard_id * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
-        sample_key = jax.random.fold_in(rng, shard_id)
-
-        if per_shard_mb is not None and per_shard_mb < batch.x.shape[0]:
-            mb = sample_minibatch(batch, sample_key, per_shard_mb)
-        else:
-            mb = batch
-
-        def weighted_sums(params):
-            preds, new_model_state, sown = _forward(
-                apply_fn, params, state.model_state, mb.x, train=True
-            )
-            per = loss_fn(preds, mb.y)
-            den = jnp.sum(mb.w)
-            # Sown aux objectives (per-shard means, pre-weighted at the
-            # sow site) scale by den so the global psum(num)/psum(den)
-            # is the task mean plus the example-weighted mean aux —
-            # matching the sharded trainer's objective.
-            num = jnp.sum(per * mb.w) + _sown_total(sown, per.dtype) * den
-            return num, (den, new_model_state)
-
-        (num, (den, new_model_state)), grads_num = jax.value_and_grad(
-            weighted_sums, has_aux=True
-        )(state.params)
-
-        # ONE fused collective for everything the step needs globally.
-        num_g = jax.lax.psum(num, axis_names)
-        den_g = jax.lax.psum(den, axis_names)
-        grads_g = jax.lax.psum(grads_num, axis_names)
-        safe_den = jnp.maximum(den_g, 1.0)
-        grads = jax.tree.map(lambda g: g / safe_den, grads_g)
-        loss = num_g / safe_den
-
-        # Non-trainable collections (batch_stats) sync by global mean.
-        if state.model_state:
-            new_model_state = jax.tree.map(
-                lambda a: jax.lax.pmean(a, axis_names)
-                if jnp.issubdtype(a.dtype, jnp.floating)
-                else a,
-                new_model_state,
-            )
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
-
-        new_state = TrainState(
-            step=state.step + 1,
-            params=new_params,
-            model_state=new_model_state,
-            opt_state=new_opt_state,
-            rng=next_rng,
-        )
-        return new_state, StepMetrics(loss=loss, examples=den_g, grad_norm=gnorm)
+        return _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
+                        state, batch)
 
     data_spec = P(axis_names)
     batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
@@ -248,54 +335,9 @@ def make_train_epoch(
         per_shard_mb = mini_batch
 
     def shard_epoch(state: TrainState, batch: DataBatch):
-        shard_id = jnp.zeros((), jnp.int32)
-        for ax in axis_names:
-            shard_id = shard_id * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
-
         def one_step(state: TrainState, _):
-            rng, next_rng = jax.random.split(state.rng)
-            sample_key = jax.random.fold_in(rng, shard_id)
-            if per_shard_mb is not None and per_shard_mb < batch.x.shape[0]:
-                mb = sample_minibatch(batch, sample_key, per_shard_mb)
-            else:
-                mb = batch
-
-            def weighted_sums(params):
-                preds, new_model_state, sown = _forward(
-                    apply_fn, params, state.model_state, mb.x, train=True
-                )
-                per = loss_fn(preds, mb.y)
-                den = jnp.sum(mb.w)
-                num = jnp.sum(per * mb.w) + _sown_total(sown, per.dtype) * den
-                return num, (den, new_model_state)
-
-            (num, (den, new_model_state)), grads_num = jax.value_and_grad(
-                weighted_sums, has_aux=True
-            )(state.params)
-            num_g = jax.lax.psum(num, axis_names)
-            den_g = jax.lax.psum(den, axis_names)
-            grads_g = jax.lax.psum(grads_num, axis_names)
-            safe_den = jnp.maximum(den_g, 1.0)
-            grads = jax.tree.map(lambda g: g / safe_den, grads_g)
-            if state.model_state:
-                new_model_state = jax.tree.map(
-                    lambda a: jax.lax.pmean(a, axis_names)
-                    if jnp.issubdtype(a.dtype, jnp.floating)
-                    else a,
-                    new_model_state,
-                )
-            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            metrics = StepMetrics(
-                loss=num_g / safe_den,
-                examples=den_g,
-                grad_norm=optax.global_norm(grads),
-            )
-            return (
-                TrainState(state.step + 1, new_params, new_model_state,
-                           new_opt_state, next_rng),
-                metrics,
-            )
+            return _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
+                            state, batch)
 
         return jax.lax.scan(one_step, state, None, length=steps_per_call)
 
@@ -307,6 +349,119 @@ def make_train_epoch(
         in_specs=(P(), batch_specs),
         out_specs=(P(), P()),
     )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def _mask_state(active: jax.Array, new: TrainState, old: TrainState) -> TrainState:
+    """Keep ``old`` when the step is masked out (post-stop). The rng
+    always advances — once stopped no further step consumes it, so the
+    advance cannot diverge from the per-step path (and typed PRNG keys
+    don't support ``where``)."""
+    sel = lambda n, o: jnp.where(active, n, o)
+    return TrainState(
+        step=sel(new.step, old.step),
+        params=jax.tree.map(sel, new.params, old.params),
+        model_state=jax.tree.map(sel, new.model_state, old.model_state),
+        opt_state=jax.tree.map(sel, new.opt_state, old.opt_state),
+        rng=new.rng,
+    )
+
+
+def make_train_epoch_fused(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    steps_per_call: int,
+    es_config: Optional[EsConfig] = None,
+    with_val: bool = False,
+    mini_batch: Optional[int] = None,
+    axis_names: Tuple[str, ...] = BATCH_AXES,
+):
+    """Fused chunk with EXACT per-step early-stop / validation
+    semantics, decided on-device inside the ``lax.scan``.
+
+    This closes the semantic gap the per-step path otherwise covers:
+    the reference evaluates the stop vote and the val forward every
+    iteration (``distributed.py:166-197``); a plain fused chunk could
+    only check at chunk boundaries, overshooting up to
+    ``steps_per_call - 1`` steps. Here the early-stop state
+    (:class:`EsState`) rides the scan carry: the step at which the stop
+    fires latches ``stopped``, and every later step in the chunk is
+    masked to a no-op (same math executed, update discarded — bounded
+    waste, only in the one tail chunk). ``val_loss`` is computed inside
+    the scan after each step, exactly the per-iteration val forward.
+
+    Returns a jitted fn. With ``with_val``::
+
+        ((state, es), EpochMetrics) = fn((state, es), batch, val_batch)
+
+    otherwise ``fn((state, es), batch)``. ``EpochMetrics.active`` tells
+    the host how many steps actually trained.
+    """
+    per_shard_mb = None
+    if mini_batch is not None and mini_batch > 0:
+        per_shard_mb = mini_batch
+
+    def _val_loss(state: TrainState, vb: DataBatch) -> jax.Array:
+        preds, _, _ = _forward(
+            apply_fn, state.params, state.model_state, vb.x, train=False
+        )
+        per = loss_fn(preds, vb.y)
+        num = jax.lax.psum(jnp.sum(per * vb.w), axis_names)
+        den = jax.lax.psum(jnp.sum(vb.w), axis_names)
+        return num / jnp.maximum(den, 1.0)
+
+    def shard_epoch(carry, batch: DataBatch, val_batch: Optional[DataBatch]):
+        def one_step(carry, _):
+            state, es = carry
+            active = ~es.stopped
+            stepped, metrics = _dp_body(
+                apply_fn, loss_fn, tx, axis_names, per_shard_mb, state, batch
+            )
+            new_state = _mask_state(active, stepped, state)
+            if with_val:
+                val = _val_loss(new_state, val_batch)
+                signal = val
+            else:
+                val = jnp.float32(jnp.nan)
+                signal = metrics.loss
+            if es_config is not None:
+                updated = _es_update(es_config, es, signal)
+                new_es = jax.tree.map(
+                    lambda n, o: jnp.where(active, n, o), updated, es
+                )
+            else:
+                new_es = es
+            out = EpochMetrics(
+                loss=metrics.loss,
+                examples=metrics.examples,
+                grad_norm=metrics.grad_norm,
+                val_loss=val,
+                active=active,
+            )
+            return (new_state, new_es), out
+
+        return jax.lax.scan(one_step, carry, None, length=steps_per_call)
+
+    data_spec = P(axis_names)
+    batch_specs = DataBatch(x=data_spec, y=data_spec, w=data_spec)
+    carry_specs = (P(), P())
+    if with_val:
+        mapped = shard_map_compat(
+            shard_epoch,
+            mesh,
+            in_specs=(carry_specs, batch_specs, batch_specs),
+            out_specs=((P(), P()), P()),
+        )
+    else:
+        fn = lambda carry, batch: shard_epoch(carry, batch, None)
+        mapped = shard_map_compat(
+            fn,
+            mesh,
+            in_specs=(carry_specs, batch_specs),
+            out_specs=((P(), P()), P()),
+        )
     return jax.jit(mapped, donate_argnums=(0,))
 
 

@@ -26,10 +26,13 @@ from jax.sharding import Mesh
 from sparktorch_tpu.parallel.launch import check_gang
 from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, build_mesh, replicated
 from sparktorch_tpu.train.step import (
+    EsConfig,
     TrainState,
     create_train_state,
+    init_es_state,
     make_eval_step,
     make_train_epoch,
+    make_train_epoch_fused,
     make_train_step,
 )
 from sparktorch_tpu.utils.data import DataBatch, handle_features, pad_to_multiple
@@ -207,9 +210,11 @@ def train_distributed(
         else None
     )
     # Fast path: fuse many steps into one compiled call (lax.scan) when
-    # nothing needs per-step host decisions. Early stopping and the
-    # per-iter val forward keep exact reference semantics on the
-    # step-per-call path.
+    # nothing needs per-step host decisions. With early stopping or a
+    # val forward the default stays at 1 step/call; an EXPLICIT
+    # steps_per_call > 1 keeps exact per-step semantics too — the stop
+    # decision and val forward move inside the fused scan
+    # (make_train_epoch_fused), masking post-stop steps to no-ops.
     if steps_per_call is None:
         steps_per_call = 1 if (stopper is not None or val_batch is not None) else min(iters, 32)
         if ckpt is not None and checkpoint_every > 0:
@@ -222,7 +227,21 @@ def train_distributed(
     while iters % steps_per_call != 0:
         steps_per_call -= 1
 
-    if steps_per_call > 1:
+    fused_signals = steps_per_call > 1 and (
+        stopper is not None or val_batch is not None
+    )
+    es_state = init_es_state() if fused_signals else None
+    if fused_signals:
+        train_step = make_train_epoch_fused(
+            module.apply, loss_fn, tx, mesh, steps_per_call,
+            es_config=(
+                EsConfig(patience=early_stop_patience)
+                if stopper is not None else None
+            ),
+            with_val=val_batch is not None,
+            mini_batch=mini_batch,
+        )
+    elif steps_per_call > 1:
         train_step = make_train_epoch(
             module.apply, loss_fn, tx, mesh, steps_per_call, mini_batch=mini_batch
         )
@@ -231,7 +250,9 @@ def train_distributed(
             module.apply, loss_fn, tx, mesh, mini_batch=mini_batch
         )
     eval_step = (
-        make_eval_step(module.apply, loss_fn, mesh) if val_batch is not None else None
+        make_eval_step(module.apply, loss_fn, mesh)
+        if val_batch is not None and not fused_signals
+        else None
     )
 
     from sparktorch_tpu.utils.metrics import MetricsRecorder
@@ -267,14 +288,30 @@ def train_distributed(
                 if steps_per_call > 1:
                     n = min(steps_per_call, iters - i)
                     with step_annotation(int(metrics[-1]["iter"]) + 1 if metrics else 0):
-                        state, stacked = train_step(state, train_batch)
+                        if fused_signals:
+                            args = (((state, es_state), train_batch, val_batch)
+                                    if val_batch is not None
+                                    else ((state, es_state), train_batch))
+                            (state, es_state), stacked = train_step(*args)
+                        else:
+                            state, stacked = train_step(state, train_batch)
                     losses = np.asarray(stacked.loss)[:n]
                     examples = np.asarray(stacked.examples)[:n]
                     gnorms = np.asarray(stacked.grad_norm)[:n]
-                    dt = (time.perf_counter() - t0) / n
+                    if fused_signals:
+                        vals = np.asarray(stacked.val_loss)[:n]
+                        actives = np.asarray(stacked.active)[:n]
+                    else:
+                        vals = [None] * n
+                        actives = [True] * n
+                    n_active = int(np.sum(np.asarray(actives)))
+                    dt = (time.perf_counter() - t0) / max(1, n_active)
                     chunk = [
-                        (float(l), float(e), float(g))
-                        for l, e, g in zip(losses, examples, gnorms)
+                        (float(l), float(e), float(g),
+                         None if v is None or np.isnan(v) else float(v),
+                         bool(a))
+                        for l, e, g, v, a in zip(losses, examples, gnorms,
+                                                 vals, actives)
                     ]
                 else:
                     with step_annotation(i):
@@ -283,15 +320,17 @@ def train_distributed(
                         float(step_metrics.loss),
                         float(step_metrics.examples),
                         float(step_metrics.grad_norm),
+                        float(eval_step(state, val_batch))
+                        if eval_step is not None else None,
+                        True,
                     )]
                     dt = time.perf_counter() - t0
 
-                for loss, examples_n, gnorm in chunk:
-                    val_loss = (
-                        float(eval_step(state, val_batch))
-                        if eval_step is not None and steps_per_call == 1
-                        else None
-                    )
+                for loss, examples_n, gnorm, val_loss, active in chunk:
+                    if not active:
+                        # Step masked out inside the fused chunk: the
+                        # stop had already fired — nothing trained.
+                        break
                     record = {
                         "round": shuffle_round,
                         "iter": i,
@@ -314,13 +353,16 @@ def train_distributed(
                     # Early stop needs no collective: `loss` is already the
                     # global mean, identical on every host (vs the
                     # reference's two extra all_reduces,
-                    # distributed.py:186-197).
-                    if stopper is not None:
+                    # distributed.py:186-197). On the fused path the
+                    # decision already happened on-device (EsState).
+                    if stopper is not None and not fused_signals:
                         signal = val_loss if val_loss is not None else loss
                         if stopper.step(signal):
                             stop = True
                             break
                     i += 1
+                if fused_signals and bool(jax.device_get(es_state.stopped)):
+                    stop = True
                 last_ckpt_step = _save_if_due(
                     ckpt, state, last_ckpt_step, checkpoint_every
                 )

@@ -185,6 +185,25 @@ def test_barrier_mode_empty_partition(spark):
 
 
 @pytest.mark.slow
+def test_hogwild_executor_push_every_windows(data):
+    """VERDICT r2 item 5: pushEvery must reach the executor deployment.
+    With pushEvery=4 over 16 iters x 2 workers, the server applies
+    ~2*(16/4)=8 window pushes — NOT 32 per-iteration pushes — proving
+    the wire carried fused window gradients. compress=False also rides
+    the Param into HttpTransport."""
+    est = _estimator(mode="hogwild", deployMode="barrier", partitions=2,
+                     iters=16, miniBatch=32, pushEvery=4, compress=False)
+    model = est.fit(data)
+    assert isinstance(model, SparkTorchModel)
+    applied = est._last_hogwild_applied
+    assert applied == 2 * (16 // 4), applied
+    # Per-iter loss records still cover every iteration (windows report
+    # k losses each).
+    summaries = est._last_hogwild_summaries
+    assert all(len(s["losses"]) == 16 for s in summaries)
+
+
+@pytest.mark.slow
 def test_hogwild_executor_shuffles_and_validation(data):
     """partitionShuffles reruns worker rounds with fresh seeds and
     validationPct carves a per-partition holdout (both silently
