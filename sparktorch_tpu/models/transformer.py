@@ -55,6 +55,10 @@ class TransformerConfig:
     moe_every: int = 2          # every k-th layer uses the MoE FFN
     capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2  # switch-style load-balance loss
+    # Routing fan-out per token. 1 = switch-style (gate = raw top prob);
+    # k>=2 = GShard-style: top-k experts with gates renormalized over
+    # the chosen k, first choices claim capacity before second choices.
+    moe_top_k: int = 1
     # Routing group size: tokens route within fixed-size groups, so
     # the dispatch/combine one-hots are O(n * group * cf) elements —
     # linear in total tokens — instead of O(n^2) with global routing.
@@ -110,38 +114,46 @@ class MultiHeadAttention(nn.Module):
 
 
 class MoEFFN(nn.Module):
-    """Switch-style top-1 mixture-of-experts FFN.
+    """Top-k mixture-of-experts FFN (switch-style at k=1, GShard-style
+    gate-weighted combine at k>=2).
 
     No reference counterpart (SURVEY §2.4: EP "absent"). TPU-first
-    design: routing, dispatch, expert matmuls and combine are four
-    einsums over a (experts, capacity, d_model) layout — no per-expert
-    Python, no dynamic shapes. Expert weights have a leading experts
-    dim that the sharding rules place on the ``ep`` mesh axis; under
-    GSPMD the dispatch einsum's operands (tokens sharded over dp,
-    experts sharded over ep) force the all-to-all, and the combine
-    reverses it. The switch load-balance loss is sown (pre-weighted by
-    ``moe_aux_weight``) into the ``losses`` collection; the sharded
-    trainer adds every sown loss to the objective.
+    design: routing, dispatch, expert matmuls and combine are einsums
+    over a (experts, capacity, d_model) layout — no per-expert Python,
+    no dynamic shapes. Expert weights have a leading experts dim that
+    the sharding rules place on the ``ep`` mesh axis; under GSPMD the
+    dispatch einsum's operands (tokens sharded over dp, experts sharded
+    over ep) force the all-to-all, and the combine reverses it. The
+    switch load-balance loss is sown (pre-weighted by
+    ``moe_aux_weight``) into the ``losses`` collection; every trainer
+    adds sown losses to the objective.
 
     Tokens route within fixed-size groups (``moe_group_size``), so the
-    dispatch/combine one-hots stay linear in total tokens. Known
-    limitation: weight-0 padding rows (the empty-partition protocol)
-    still participate in routing and the aux loss — the module never
-    sees per-example weights. Shard-divisibility padding adds fewer
-    than n_batch_shards rows, so keep padding fractions small relative
-    to the batch.
+    dispatch/combine one-hots stay linear in total tokens.
+
+    ``token_w`` (per-token weights, (b, s)) masks weight-0 rows — the
+    empty-partition padding protocol — OUT of routing: masked tokens
+    claim no capacity, contribute nothing to the aux loss, and get
+    zero expert output (their residual path carries them). Trainers
+    pass the batch's example weights down automatically (step._forward).
+
+    Observability: the fraction of routed token-choices dropped at
+    capacity is sown into the ``moe_metrics`` collection as raw
+    (dropped, routed) counts; trainers psum them and expose
+    ``moe_drop_fraction`` in the step metrics.
     """
 
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, token_w=None):
         import math
 
         cfg = self.config
         dt = cfg.compute_dtype
         b, s, d = x.shape
         e = cfg.n_experts
+        k = max(1, min(cfg.moe_top_k, e))
         n = b * s
         # Largest group size <= moe_group_size dividing n (n and the
         # bound are trace-time ints, so this loop is free).
@@ -150,28 +162,46 @@ class MoEFFN(nn.Module):
             g -= 1
         n_groups = n // g
         tokens = x.reshape(n_groups, g, d)
-        # Static per-group capacity: ceil(capacity_factor * g / e).
-        cap = max(1, math.ceil(cfg.capacity_factor * g / e))
+        # Static per-group capacity: ceil(cf * g * k / e) — scales with
+        # the routing fan-out so k=2 doesn't halve effective capacity.
+        cap = max(1, math.ceil(cfg.capacity_factor * g * k / e))
+        if token_w is not None:
+            mask = (token_w.reshape(n_groups, g) > 0)      # (G, g) bool
+        else:
+            mask = None
 
         # Router in f32 (small matmul; numerics matter more than MXU).
         logits = nn.Dense(e, dtype=jnp.float32, name="router")(
             tokens.astype(jnp.float32)
         )                                            # (G, g, e)
         probs = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.max(probs, axis=-1)               # (G, g)
-        choice = jnp.argmax(probs, axis=-1)          # (G, g)
+        topk_p, topk_idx = jax.lax.top_k(probs, k)   # (G, g, k)
+        if k == 1:
+            gates = topk_p                           # switch: raw prob
+        else:
+            gates = topk_p / jnp.maximum(
+                jnp.sum(topk_p, axis=-1, keepdims=True), 1e-9
+            )
 
-        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)   # (G, g, e)
-        # 1-based arrival rank of each token within its expert (per
-        # group); tokens past capacity are DROPPED (their residual
-        # path carries them).
-        pos = jnp.cumsum(onehot, axis=1) * onehot
+        oh = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # (G, g, k, e)
+        if mask is not None:
+            oh = oh * mask[:, :, None, None]
+            gates = gates * mask[:, :, None]
+        # Capacity assignment with choice-level priority: ALL first
+        # choices rank before any second choice (GShard). Flatten
+        # (k, g) choice-major, cumsum arrival order, unflatten.
+        oh_t = oh.transpose(0, 2, 1, 3).reshape(n_groups, k * g, e)
+        pos = jnp.cumsum(oh_t, axis=1) * oh_t        # 1-based rank
         keep = (pos > 0) & (pos <= cap)
         slot = jnp.clip(pos - 1, 0, cap - 1)
-        dispatch = (
-            keep[..., None] & jax.nn.one_hot(slot, cap, dtype=bool)
-        ).astype(dt)                                 # (G, g, e, cap)
+        disp_flat = keep[..., None] & jax.nn.one_hot(slot, cap, dtype=bool)
+        disp = disp_flat.reshape(n_groups, k, g, e, cap).transpose(
+            0, 2, 1, 3, 4
+        )                                            # (G, g, k, e, cap)
 
+        # A token's k choices hit k DISTINCT experts, so summing over
+        # the choice dim yields a 0/1 dispatch tensor.
+        dispatch = jnp.any(disp, axis=2).astype(dt)  # (G, g, e, cap)
         expert_in = jnp.einsum("gnec,gnd->gecd", dispatch,
                                tokens.astype(dt))    # (G, e, cap, d)
         w_in = self.param("moe_w_in", nn.initializers.lecun_normal(),
@@ -185,17 +215,34 @@ class MoEFFN(nn.Module):
         expert_out = jnp.einsum("gecf,efd->gecd", h, w_out.astype(dt))
         expert_out = expert_out + b_out[None, :, None].astype(dt)
 
-        combine = dispatch * gate[..., None, None].astype(dt)
+        # Gate-weighted combine over the kept (token, choice) slots.
+        combine = jnp.einsum("gnk,gnkec->gnec", gates.astype(dt),
+                             disp.astype(dt))        # (G, g, e, cap)
         out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
 
-        # Switch load-balance loss: e * sum_e fraction_e * prob_e,
-        # averaged over groups.
-        frac = jnp.mean(onehot.astype(jnp.float32), axis=1)   # (G, e)
-        mean_prob = jnp.mean(probs, axis=1)                   # (G, e)
+        # Switch load-balance loss over VALID tokens only: e * sum_e
+        # frac_e * prob_e, where frac uses the primary (first) choice.
+        oh0 = oh[:, :, 0, :].astype(jnp.float32)     # (G, g, e)
+        if mask is not None:
+            mf = mask.astype(jnp.float32)
+            valid = jnp.maximum(jnp.sum(mf, axis=1), 1.0)         # (G,)
+            frac = jnp.sum(oh0, axis=1) / valid[:, None]
+            mean_prob = (
+                jnp.sum(probs * mf[:, :, None], axis=1) / valid[:, None]
+            )
+        else:
+            frac = jnp.mean(oh0, axis=1)                          # (G, e)
+            mean_prob = jnp.mean(probs, axis=1)                   # (G, e)
         aux = cfg.moe_aux_weight * e * jnp.mean(
             jnp.sum(frac * mean_prob, axis=-1)
         )
         self.sow("losses", "moe_aux", aux)
+
+        # Raw drop counts (masked tokens never counted as routed).
+        routed = jnp.sum(oh).astype(jnp.float32)
+        kept = jnp.sum(keep.astype(jnp.float32))
+        self.sow("moe_metrics", "dropped", routed - kept)
+        self.sow("moe_metrics", "routed", routed)
         return out.reshape(b, s, d)
 
 
@@ -204,14 +251,14 @@ class EncoderLayer(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, token_w=None):
         cfg = self.config
         dt = cfg.compute_dtype
         h = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
         x = x + MultiHeadAttention(cfg, name="attn")(h)
         h = nn.LayerNorm(dtype=dt, name="ln_mlp")(x)
         if self.use_moe:
-            h = MoEFFN(cfg, name="moe")(h)
+            h = MoEFFN(cfg, name="moe")(h, token_w)
         else:
             h = nn.Dense(cfg.d_ff, dtype=dt, name="mlp_in")(h)
             h = nn.gelu(h)
@@ -230,7 +277,7 @@ class Transformer(nn.Module):
     embed: Optional[nn.Module] = None
 
     @nn.compact
-    def __call__(self, ids):
+    def __call__(self, ids, example_w=None):
         cfg = self.config
         if jnp.issubdtype(ids.dtype, jnp.floating):
             ids = ids.astype(jnp.int32)
@@ -246,6 +293,12 @@ class Transformer(nn.Module):
             (cfg.max_len, cfg.d_model),
         )
         x = tok + pos[None, :s].astype(cfg.compute_dtype)
+        # Per-token weights for MoE routing: padding EXAMPLES (w=0,
+        # the empty-partition protocol) broadcast over their tokens.
+        token_w = (
+            jnp.broadcast_to(example_w[:, None], (b, s))
+            if example_w is not None and cfg.n_experts > 0 else None
+        )
         layer = EncoderLayer
         if cfg.remat:
             layer = nn.remat(EncoderLayer)
@@ -253,7 +306,7 @@ class Transformer(nn.Module):
             use_moe = (
                 cfg.n_experts > 0 and (i + 1) % max(1, cfg.moe_every) == 0
             )
-            x = layer(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
+            x = layer(cfg, use_moe=use_moe, name=f"layer_{i}")(x, token_w)
         return nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_final")(x)
 
 
@@ -263,8 +316,8 @@ class SequenceClassifier(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, ids):
-        x = Transformer(self.config, name="backbone")(ids)
+    def __call__(self, ids, example_w=None):
+        x = Transformer(self.config, name="backbone")(ids, example_w)
         # Mean-pool (padding-id masking is the caller's concern; the
         # estimator's weighted loss handles padded *examples*).
         pooled = jnp.mean(x, axis=1)
@@ -296,8 +349,8 @@ class CausalLM(nn.Module):
             self.backbone = Transformer(cfg)
             self.lm_head = nn.Dense(cfg.vocab_size, dtype=jnp.float32)
 
-    def __call__(self, ids):
-        x = self.backbone(ids)
+    def __call__(self, ids, example_w=None):
+        x = self.backbone(ids, example_w)
         if self.config.tie_embeddings:
             # f32 logits like the untied Dense head (attend would run
             # the vocab matmul in the embed's compute dtype; logit

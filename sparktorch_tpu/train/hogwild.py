@@ -158,12 +158,18 @@ def make_grad_step(apply_fn, loss_fn, mini_batch: Optional[int] = None):
             batch = shard
 
         def weighted(params):
+            from sparktorch_tpu.train.step import _accepts_example_w
+
             variables = {"params": params, **(model_state or {})}
+            kwargs = (
+                {"example_w": batch.w} if _accepts_example_w(apply_fn) else {}
+            )
             # Request the write-only 'losses' collection so sown aux
             # objectives (MoE load-balance) train here too — the async
             # router must optimize the same objective as the sync one.
             preds, sown_state = apply_fn(variables, batch.x,
-                                         mutable=["losses"])
+                                         mutable=["losses", "moe_metrics"],
+                                         **kwargs)
             per = loss_fn(preds, batch.y)
             num = jnp.sum(per * batch.w)
             den = jnp.maximum(jnp.sum(batch.w), 1.0)

@@ -1,4 +1,5 @@
-"""GPipe pipeline parallelism over the ``pp`` mesh axis.
+"""GPipe pipeline parallelism over the ``pp`` mesh axis, composable
+with tensor parallelism over ``tp``.
 
 No reference counterpart (SURVEY §2.4: PP "absent"). TPU-first
 design: the transformer stack is split into ``pp`` stages — the
@@ -15,13 +16,33 @@ The whole schedule (M + S - 1 ticks) is one ``lax.scan`` inside one
 jitted ``shard_map``: zero per-tick Python, static shapes, and the
 bubble is the textbook (S-1)/(M+S-1) fraction — raise ``n_micro`` to
 shrink it.
+
+Within a stage the encoder layer is computed in explicit einsum form
+(same math and param tree as ``models.transformer.EncoderLayer``) so
+that:
+
+- **tp composes**: attention heads and FFN columns are sliced over the
+  ``tp`` axis, with the classic Megatron f/g pair implemented as
+  custom-vjp ops (:func:`_tp_enter`: identity forward / psum backward
+  at the entry of each parallel region; :func:`_tp_reduce`: psum
+  forward / identity backward at its exit). With those two ops every
+  parameter gradient is complete and tp-identical without any
+  tp-axis gradient reduction.
+- **remat works**: each layer's forward is wrapped in
+  ``jax.checkpoint`` when ``cfg.remat`` — activations recompute in the
+  backward pass, the standard memory/FLOPs trade for deep stacks.
+- **flash attention works**: ``attn_impl='flash'`` calls the Pallas
+  streaming kernel on the local heads (a kernel is a primitive, not a
+  nested shard_map, so it composes with the pp schedule; ring
+  attention's own shard_map island does not and stays rejected).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,7 +50,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparktorch_tpu.models.transformer import EncoderLayer, TransformerConfig
-from sparktorch_tpu.parallel.mesh import AXIS_DP, AXIS_PP
+from sparktorch_tpu.ops.attention import dense_attention
+from sparktorch_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP
 from sparktorch_tpu.train.step import shard_map_compat
 from sparktorch_tpu.utils.data import DataBatch
 
@@ -38,6 +60,101 @@ class PipelineState(NamedTuple):
     step: jax.Array
     params: Any
     opt_state: Any
+
+
+# ---------------------------------------------------------------------------
+# Megatron-style f/g for tensor parallelism (exact grads, no tp-axis
+# gradient reductions needed anywhere).
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def _tp_enter(x):
+    """Entry of a tp-parallel region: identity forward, psum backward.
+    Makes cotangents on the replicated stream complete (summed over
+    every head/column slice) and tp-identical."""
+    return x
+
+
+def _tp_enter_fwd(x):
+    return x, None
+
+
+def _tp_enter_bwd(_, ct):
+    return (jax.lax.psum(ct, AXIS_TP),)
+
+
+_tp_enter.defvjp(_tp_enter_fwd, _tp_enter_bwd)
+
+
+@jax.custom_vjp
+def _tp_reduce(x):
+    """Exit of a tp-parallel region: psum forward, identity backward
+    (each slice receives the full output cotangent)."""
+    return jax.lax.psum(x, AXIS_TP)
+
+
+def _tp_reduce_fwd(x):
+    return jax.lax.psum(x, AXIS_TP), None
+
+
+def _tp_reduce_bwd(_, ct):
+    return (ct,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Stage math (EncoderLayer's exact param tree, explicit einsum form)
+# ---------------------------------------------------------------------------
+
+
+def _ln(p, x, dt):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    xf = (xf - mean) / jnp.sqrt(var + 1e-6)
+    return (xf * p["scale"] + p["bias"]).astype(dt)
+
+
+def _layer_forward(cfg: TransformerConfig, lp, h):
+    """One encoder layer on this device's head/column slice.
+
+    ``lp`` is the layer's param tree with ``qkv``/``proj``/``mlp``
+    kernels already SLICED over tp (shard_map did that); ln params and
+    output-side biases arrive replicated. Replicated output-side
+    biases are added AFTER :func:`_tp_reduce` (once, undivided): the
+    cotangent there is the full output cotangent on every slice, so
+    their gradients come out complete and tp-identical with no
+    reduction — adding a 1/tp-scaled bias inside the reduce instead
+    would silently shrink those gradients by tp (caught by the SGD
+    grad-parity test).
+    """
+    dt = cfg.compute_dtype
+    a = _tp_enter(_ln(lp["ln_attn"], h, dt))
+    qkv_k = lp["attn"]["qkv"]["kernel"].astype(dt)     # (d, 3, h_loc, hd)
+    qkv_b = lp["attn"]["qkv"]["bias"].astype(dt)       # (3, h_loc, hd)
+    qkv = jnp.einsum("bsd,dthf->bsthf", a, qkv_k) + qkv_b
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (b, s, h_loc, hd)
+    if cfg.attn_impl == "flash":
+        from sparktorch_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, cfg.causal)
+    else:
+        out = dense_attention(q, k, v, causal=cfg.causal)
+    proj_k = lp["attn"]["proj"]["kernel"].astype(dt)   # (h_loc, hd, d)
+    proj_b = lp["attn"]["proj"]["bias"].astype(dt)     # (d,) replicated
+    attn_out = _tp_reduce(jnp.einsum("bshf,hfd->bsd", out, proj_k)) + proj_b
+    x = h + attn_out
+
+    m = _tp_enter(_ln(lp["ln_mlp"], x, dt))
+    w1 = lp["mlp_in"]["kernel"].astype(dt)             # (d, ff_loc)
+    b1 = lp["mlp_in"]["bias"].astype(dt)               # (ff_loc,)
+    mid = nn.gelu(m @ w1 + b1)
+    w2 = lp["mlp_out"]["kernel"].astype(dt)            # (ff_loc, d)
+    b2 = lp["mlp_out"]["bias"].astype(dt)              # (d,) replicated
+    return x + _tp_reduce(mid @ w2) + b2
 
 
 def init_pipeline_lm(cfg: TransformerConfig, key: jax.Array):
@@ -65,12 +182,44 @@ def init_pipeline_lm(cfg: TransformerConfig, key: jax.Array):
     return params
 
 
+# Per-leaf tp sharding of the stacked layer tree, keyed by the dim the
+# head/column slice lives on (after the leading layer-stack dim).
+_TP_LAYER_DIMS = {
+    ("attn", "qkv", "kernel"): 3,   # (L, d, 3, h, hd) -> heads
+    ("attn", "qkv", "bias"): 2,     # (L, 3, h, hd)
+    ("attn", "proj", "kernel"): 1,  # (L, h, hd, d)
+    ("mlp_in", "kernel"): 2,        # (L, d, ff)
+    ("mlp_in", "bias"): 1,          # (L, ff)
+    ("mlp_out", "kernel"): 1,       # (L, ff, d)
+}
+
+
+def _layer_leaf_spec(path_names: Tuple[str, ...], ndim: int) -> P:
+    """Spec for one stacked-layer leaf: pp on the stack dim, tp on the
+    leaf's head/column dim when it has one."""
+    for key, dim in _TP_LAYER_DIMS.items():
+        if path_names[-len(key):] == key:
+            parts = [AXIS_PP] + [None] * (ndim - 1)
+            parts[dim] = AXIS_TP
+            return P(*parts)
+    return P(AXIS_PP)
+
+
 def _param_specs(params) -> Any:
     """Per-leaf PartitionSpecs: layer stacks split over pp on their
-    leading (layer) dim; everything else replicated."""
+    leading (layer) dim and over tp on head/column dims; everything
+    else replicated."""
+    from jax.tree_util import tree_map_with_path
+
+    def layers_spec(path, leaf):
+        names = tuple(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path
+        )
+        return _layer_leaf_spec(names, np.ndim(leaf))
+
     return {
         k: (
-            jax.tree.map(lambda _: P(AXIS_PP), v)
+            tree_map_with_path(layers_spec, v)
             if k == "layers"
             else jax.tree.map(lambda _: P(), v)
         )
@@ -98,34 +247,45 @@ def make_pp_train_step(
     mesh: Mesh,
     n_micro: int,
 ) -> Callable[[PipelineState, DataBatch], Tuple[PipelineState, jax.Array]]:
-    """Build the jitted pipelined train step over ``mesh`` (dp x pp;
-    other axes must be 1 for this trainer)."""
+    """Build the jitted pipelined train step over ``mesh`` (dp x pp x
+    tp; other axes must be 1 for this trainer)."""
     for ax in mesh.shape:
-        if ax not in (AXIS_DP, AXIS_PP) and mesh.shape[ax] != 1:
-            raise ValueError(f"pipeline trainer supports dp x pp only; {ax}>1")
+        if ax not in (AXIS_DP, AXIS_PP, AXIS_TP) and mesh.shape[ax] != 1:
+            raise ValueError(
+                f"pipeline trainer supports dp x pp x tp only; {ax}>1"
+            )
     S = mesh.shape[AXIS_PP]
+    T = mesh.shape[AXIS_TP]
     if cfg.n_layers % max(1, S) != 0:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={S}")
+    if cfg.n_heads % max(1, T) != 0:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={T}")
+    if cfg.d_ff % max(1, T) != 0:
+        raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={T}")
     # The pipelined stack is the homogeneous dense EncoderLayer; fail
     # loudly rather than silently training a different model.
     if cfg.n_experts > 0:
-        raise ValueError("pipeline trainer does not support MoE layers yet")
-    if cfg.remat:
-        raise ValueError("pipeline trainer does not support remat yet")
-    if cfg.attn_impl != "dense":
-        # ring/flash open their own shard_map / Pallas islands, which
-        # do not compose with the pp shard_map schedule yet.
         raise ValueError(
-            f"pipeline trainer supports attn_impl='dense' only "
-            f"(got {cfg.attn_impl!r})"
+            "pipeline trainer does not support MoE layers (heterogeneous "
+            "stage stacks); use the GSPMD sharded trainer for MoE"
+        )
+    if cfg.attn_impl == "ring":
+        # ring opens its own shard_map island, which does not compose
+        # with the pp shard_map schedule.
+        raise ValueError(
+            "pipeline trainer supports attn_impl 'dense' or 'flash' "
+            "(ring attention's shard_map island does not nest)"
         )
     cfg = dataclasses.replace(cfg, causal=True)
-    layer = EncoderLayer(cfg)
     dt = cfg.compute_dtype
 
     def stage_fn(local_layers, h):
+        layer_fwd = lambda lp, h: _layer_forward(cfg, lp, h)
+        if cfg.remat:
+            layer_fwd = jax.checkpoint(layer_fwd)
+
         def body(h, lp):
-            return layer.apply({"params": lp}, h), None
+            return layer_fwd(lp, h), None
 
         h, _ = jax.lax.scan(body, h, local_layers)
         return h
@@ -136,11 +296,8 @@ def make_pp_train_step(
         return h.astype(dt)
 
     def head_loss(params, h, y, w):
-        hf = h.astype(jnp.float32)
-        mean = hf.mean(-1, keepdims=True)
-        var = ((hf - mean) ** 2).mean(-1, keepdims=True)
-        hf = (hf - mean) / jnp.sqrt(var + 1e-6)
-        hf = hf * params["ln_scale"] + params["ln_bias"]
+        hf = _ln({"scale": params["ln_scale"], "bias": params["ln_bias"]},
+                 h, jnp.float32)
         logits = hf @ params["head_w"] + params["head_b"]
         per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         per_ex = per_tok.mean(-1)
@@ -202,7 +359,9 @@ def make_pp_train_step(
         # param is replicated across: layer stacks live on one pp
         # shard each (sum over dp only); embed/head/norm are used on
         # all stages (masked elsewhere -> zero grads) and replicated
-        # over both axes.
+        # over both axes. No tp reductions anywhere: the f/g pair in
+        # _layer_forward already makes every grad complete and
+        # tp-identical.
         grads = {
             k: (
                 jax.tree.map(lambda g: jax.lax.psum(g, AXIS_DP), v)
@@ -256,3 +415,142 @@ def _opt_specs(tx, opt_state, param_specs):
         transform_non_params=lambda _: P(),
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+# ---------------------------------------------------------------------------
+# ModelSpec / estimator integration: pp as a mesh-config choice
+# ---------------------------------------------------------------------------
+
+
+def pipeline_params_from_flax(params, n_layers: int):
+    """Convert a ``CausalLM`` (untied) flax param tree into the
+    pipeline's stacked layout. Inverse of
+    :func:`flax_params_from_pipeline`."""
+    bb = params["backbone"]
+    layer_trees = [bb[f"layer_{i}"] for i in range(n_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_trees)
+    return {
+        "layers": stacked,
+        "tok_embed": bb["tok_embed"]["embedding"],
+        "pos_embed": bb["pos_embed"],
+        "ln_scale": bb["ln_final"]["scale"],
+        "ln_bias": bb["ln_final"]["bias"],
+        "head_w": params["lm_head"]["kernel"],
+        "head_b": params["lm_head"]["bias"],
+    }
+
+
+def flax_params_from_pipeline(pparams, n_layers: int):
+    """Back to the ``CausalLM`` flax tree (so the fitted bundle
+    transforms through the ordinary module apply)."""
+    bb = {
+        f"layer_{i}": jax.tree.map(lambda a: a[i], pparams["layers"])
+        for i in range(n_layers)
+    }
+    bb["tok_embed"] = {"embedding": pparams["tok_embed"]}
+    bb["pos_embed"] = pparams["pos_embed"]
+    bb["ln_final"] = {"scale": pparams["ln_scale"],
+                      "bias": pparams["ln_bias"]}
+    return {
+        "backbone": bb,
+        "lm_head": {"kernel": pparams["head_w"], "bias": pparams["head_b"]},
+    }
+
+
+def train_distributed_pipeline(
+    spec,
+    data,
+    labels=None,
+    mesh: Optional[Mesh] = None,
+    iters: int = 10,
+    n_micro: int = 4,
+    verbose: int = 0,
+    seed: int = 0,
+    metrics_hook=None,
+):
+    """Pipelined training entry for a ``ModelSpec`` holding a
+    ``CausalLM`` — the dispatch target ``train_distributed`` uses when
+    the mesh has pp > 1, so pp is a MESH choice on the ordinary
+    Estimator/ModelSpec surface, not a separate API.
+
+    The spec's flax params are initialized normally, restacked into
+    the pipeline layout, trained under the GPipe schedule, and
+    unstacked back — the returned ``TrainResult`` bundles ordinary
+    ``CausalLM`` params that transform through the module apply.
+    """
+    import time
+
+    from sparktorch_tpu.models.transformer import CausalLM
+    from sparktorch_tpu.train.sync import TrainResult
+    from sparktorch_tpu.utils.metrics import MetricsRecorder
+
+    module = spec.make_module()
+    if not isinstance(module, CausalLM):
+        raise ValueError(
+            "pipeline-parallel training (mesh pp>1) currently supports "
+            f"CausalLM specs; got {type(module).__name__}. Use a mesh "
+            "with pp=1 for other model families."
+        )
+    cfg = module.config
+    if cfg.tie_embeddings:
+        raise ValueError("pp training does not support tie_embeddings yet")
+    if spec.loss not in ("cross_entropy", "cross_entropy_fused", "nll"):
+        raise ValueError(
+            f"pp training uses token-level cross entropy; got {spec.loss!r}"
+        )
+
+    if isinstance(data, DataBatch):
+        x = np.asarray(data.x)
+        y = np.asarray(data.y)
+        w = np.asarray(data.w, dtype=np.float32)
+    elif (isinstance(data, tuple) and len(data) == 2 and labels is None):
+        # The (x, y) tuple form _as_batch accepts on the pp=1 path.
+        x = np.asarray(data[0])
+        y = np.asarray(data[1])
+        w = np.ones((x.shape[0],), np.float32)
+    else:
+        x = np.asarray(data)
+        y = np.asarray(labels) if labels is not None else None
+        if y is None:  # next-token LM on a single id matrix
+            x, y = x[:, :-1], x[:, 1:]
+        w = np.ones((x.shape[0],), np.float32)
+    x = x.astype(np.int32)
+    y = y.astype(np.int32)
+
+    dp = mesh.shape[AXIS_DP]
+    need = dp * n_micro
+    n = int(np.sum(w > 0))
+    pad = (-x.shape[0]) % need
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        w = np.concatenate([w, np.zeros((pad,), np.float32)])
+    batch = DataBatch(x=jnp.asarray(x), y=jnp.asarray(y), w=jnp.asarray(w))
+
+    tx = spec.make_optimizer()
+    rng = jax.random.key(seed)
+    flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
+    pparams = pipeline_params_from_flax(flax_params, cfg.n_layers)
+    state = place_pipeline_state(pparams, tx, mesh)
+    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro)
+
+    recorder = MetricsRecorder(n_chips=mesh.size)
+    for i in range(iters):
+        t0 = time.perf_counter()
+        state, loss = step(state, batch)
+        record = {
+            "round": 0, "iter": i, "loss": float(loss), "val_loss": None,
+            "examples": float(n), "grad_norm": float("nan"),
+            "step_time_s": time.perf_counter() - t0,
+        }
+        recorder.record(record)
+        if metrics_hook:
+            metrics_hook(record)
+        if verbose:
+            print(f"[sparktorch_tpu:pp] iter {i} loss {float(loss):.6f}")
+
+    trained = jax.device_get(state.params)
+    out_params = flax_params_from_pipeline(trained, cfg.n_layers)
+    return TrainResult(params=out_params, model_state={},
+                       metrics=recorder.records, spec=spec,
+                       summary=recorder.summary())

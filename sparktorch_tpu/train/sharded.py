@@ -25,7 +25,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparktorch_tpu.parallel.mesh import AXIS_SP, BATCH_AXES, replicated
 from sparktorch_tpu.parallel.sharding_rules import shard_params, transformer_rules
-from sparktorch_tpu.train.step import StepMetrics, TrainState, _split_variables
+from sparktorch_tpu.train.step import (
+    StepMetrics,
+    TrainState,
+    _accepts_example_w,
+    _moe_drop_counts,
+    _split_variables,
+)
 from sparktorch_tpu.utils.data import DataBatch
 
 
@@ -139,17 +145,22 @@ def make_sharded_train_step(
     """One GSPMD train step: global weighted-mean loss and grads; XLA
     derives every collective from the shardings."""
 
+    pass_w = _accepts_example_w(apply_fn)
+
     def step(state: TrainState, batch: DataBatch):
         def weighted_mean_loss(params):
             variables = {"params": params, **state.model_state}
-            # 'losses' is write-only: requested mutable every step so
-            # sow() records fresh values, but never carried in the
-            # train state (sow APPENDS to carried-in collections,
-            # which would grow the pytree every step).
-            mutable = [*state.model_state.keys(), "losses"]
-            preds, new_state = apply_fn(variables, batch.x, mutable=mutable)
+            # 'losses'/'moe_metrics' are write-only: requested mutable
+            # every step so sow() records fresh values, but never
+            # carried in the train state (sow APPENDS to carried-in
+            # collections, which would grow the pytree every step).
+            mutable = [*state.model_state.keys(), "losses", "moe_metrics"]
+            kwargs = {"example_w": batch.w} if pass_w else {}
+            preds, new_state = apply_fn(variables, batch.x, mutable=mutable,
+                                        **kwargs)
             new_state = dict(new_state)
             sown = new_state.pop("losses", None)
+            sown_metrics = new_state.pop("moe_metrics", None)
             if not state.model_state:
                 new_state = state.model_state
             per = loss_fn(preds, batch.y)
@@ -162,9 +173,9 @@ def make_sharded_train_step(
             if sown is not None:
                 for leaf in jax.tree.leaves(sown):
                     loss = loss + jnp.sum(leaf).astype(loss.dtype)
-            return loss, (den, new_state)
+            return loss, (den, new_state, _moe_drop_counts(sown_metrics))
 
-        (loss, (den, new_model_state)), grads = jax.value_and_grad(
+        (loss, (den, new_model_state, drops)), grads = jax.value_and_grad(
             weighted_mean_loss, has_aux=True
         )(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -176,8 +187,12 @@ def make_sharded_train_step(
             opt_state=new_opt,
             rng=state.rng,
         )
+        # GSPMD computes over GLOBAL arrays, so the sown counters are
+        # already global sums — no extra collective needed.
         metrics = StepMetrics(
-            loss=loss, examples=den, grad_norm=optax.global_norm(grads)
+            loss=loss, examples=den, grad_norm=optax.global_norm(grads),
+            drop_fraction=(drops[0] / jnp.maximum(drops[1], 1.0)
+                           if drops is not None else None),
         )
         return new_state, metrics
 

@@ -65,6 +65,9 @@ class StepMetrics(NamedTuple):
     loss: jax.Array        # global weighted-mean train loss
     examples: jax.Array    # real (weight>0) examples this step, global
     grad_norm: jax.Array
+    # Fraction of routed MoE token-choices dropped at expert capacity
+    # (global); None (empty pytree leaf) for models without MoE.
+    drop_fraction: Optional[jax.Array] = None
 
 
 class EpochMetrics(NamedTuple):
@@ -78,6 +81,7 @@ class EpochMetrics(NamedTuple):
     grad_norm: jax.Array
     val_loss: jax.Array
     active: jax.Array
+    drop_fraction: Optional[jax.Array] = None
 
 
 class EsConfig(NamedTuple):
@@ -143,13 +147,30 @@ def _es_update(cfg: EsConfig, es: EsState, signal: jax.Array) -> EsState:
 def _split_variables(variables) -> Tuple[Any, Any]:
     variables = dict(variables)
     params = variables.pop("params", variables)
-    # 'losses' is a write-only collection (sown aux objectives, e.g.
-    # the MoE load-balance loss); carrying it would make sow() append
-    # to it every step and grow the pytree. Every trainer re-requests
-    # it via `mutable` each training forward (_forward above;
-    # sharded.py does the same) and adds it to the objective.
+    # 'losses' and 'moe_metrics' are write-only collections (sown aux
+    # objectives / drop counters); carrying them would make sow()
+    # append every step and grow the pytree. Every trainer re-requests
+    # them via `mutable` each training forward (_forward above;
+    # sharded.py does the same).
     variables.pop("losses", None)
+    variables.pop("moe_metrics", None)
     return params, variables
+
+
+def _accepts_example_w(apply_fn) -> bool:
+    """Whether the module behind ``apply_fn`` takes per-example weights
+    (``example_w``) — the hook MoE models use to mask weight-0 padding
+    rows out of routing. ``module.apply`` is a bound method, so the
+    module's ``__call__`` signature is inspectable at trace time."""
+    import inspect
+
+    mod = getattr(apply_fn, "__self__", None)
+    if mod is None:
+        return False
+    try:
+        return "example_w" in inspect.signature(mod.__call__).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic callables
+        return False
 
 
 def create_train_state(
@@ -171,26 +192,33 @@ def create_train_state(
     )
 
 
-def _forward(apply_fn, params, model_state, x, train: bool):
+def _forward(apply_fn, params, model_state, x, train: bool, example_w=None):
     """Apply with mutable non-trainable collections when present.
 
-    Training forwards also request the write-only ``losses`` collection
-    so sown auxiliary objectives (e.g. the MoE load-balance loss) reach
-    the caller; it is popped — never carried — because ``sow`` appends
-    to carried-in collections. Returns ``(preds, new_model_state,
-    sown_losses_or_None)``.
+    Training forwards also request the write-only ``losses`` and
+    ``moe_metrics`` collections so sown auxiliary objectives (e.g. the
+    MoE load-balance loss) and observability counters reach the
+    caller; they are popped — never carried — because ``sow`` appends
+    to carried-in collections. ``example_w`` (per-example weights) is
+    forwarded to modules that accept it, letting MoE routing mask
+    weight-0 padding rows. Returns ``(preds, new_model_state,
+    sown_losses_or_None, sown_metrics_or_None)``.
     """
     variables = {"params": params, **model_state}
+    kwargs = {}
+    if example_w is not None and _accepts_example_w(apply_fn):
+        kwargs["example_w"] = example_w
     if train:
-        mutable = [*model_state.keys(), "losses"]
-        preds, new_state = apply_fn(variables, x, mutable=mutable)
+        mutable = [*model_state.keys(), "losses", "moe_metrics"]
+        preds, new_state = apply_fn(variables, x, mutable=mutable, **kwargs)
         new_state = dict(new_state)
         sown = new_state.pop("losses", None)
+        sown_metrics = new_state.pop("moe_metrics", None)
         if not model_state:
             new_state = model_state
-        return preds, new_state, sown
-    preds = apply_fn(variables, x)
-    return preds, model_state, None
+        return preds, new_state, sown, sown_metrics
+    preds = apply_fn(variables, x, **kwargs)
+    return preds, model_state, None, None
 
 
 def _sown_total(sown, dtype) -> jax.Array:
@@ -200,6 +228,28 @@ def _sown_total(sown, dtype) -> jax.Array:
         for leaf in jax.tree.leaves(sown):
             total = total + jnp.sum(leaf).astype(dtype)
     return total
+
+
+def _moe_drop_counts(sown_metrics) -> Optional[Tuple[jax.Array, jax.Array]]:
+    """Sum the sown (dropped, routed) counters across MoE layers.
+    Returns None when the model sowed none (non-MoE model) — a static
+    trace-time decision, so non-MoE programs carry no extra values."""
+    if not sown_metrics:
+        return None
+    from jax.tree_util import tree_flatten_with_path
+
+    dropped = jnp.zeros((), jnp.float32)
+    routed = jnp.zeros((), jnp.float32)
+    found = False
+    for path, leaf in tree_flatten_with_path(sown_metrics)[0]:
+        names = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+        if "dropped" in names:
+            dropped = dropped + jnp.sum(leaf)
+            found = True
+        elif "routed" in names:
+            routed = routed + jnp.sum(leaf)
+            found = True
+    return (dropped, routed) if found else None
 
 
 def _shard_index(axis_names: Tuple[str, ...]) -> jax.Array:
@@ -228,8 +278,9 @@ def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
         mb = batch
 
     def weighted_sums(params):
-        preds, new_model_state, sown = _forward(
-            apply_fn, params, state.model_state, mb.x, train=True
+        preds, new_model_state, sown, sown_metrics = _forward(
+            apply_fn, params, state.model_state, mb.x, train=True,
+            example_w=mb.w,
         )
         per = loss_fn(preds, mb.y)
         den = jnp.sum(mb.w)
@@ -238,9 +289,9 @@ def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
         # is the task mean plus the example-weighted mean aux —
         # matching the sharded trainer's objective.
         num = jnp.sum(per * mb.w) + _sown_total(sown, per.dtype) * den
-        return num, (den, new_model_state)
+        return num, (den, new_model_state, _moe_drop_counts(sown_metrics))
 
-    (num, (den, new_model_state)), grads_num = jax.value_and_grad(
+    (num, (den, new_model_state, drop_counts)), grads_num = jax.value_and_grad(
         weighted_sums, has_aux=True
     )(state.params)
 
@@ -251,6 +302,11 @@ def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
     safe_den = jnp.maximum(den_g, 1.0)
     grads = jax.tree.map(lambda g: g / safe_den, grads_g)
     loss = num_g / safe_den
+    drop_fraction = None
+    if drop_counts is not None:
+        dropped_g = jax.lax.psum(drop_counts[0], axis_names)
+        routed_g = jax.lax.psum(drop_counts[1], axis_names)
+        drop_fraction = dropped_g / jnp.maximum(routed_g, 1.0)
 
     # Non-trainable collections (batch_stats) sync by global mean.
     if state.model_state:
@@ -271,7 +327,8 @@ def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
         opt_state=new_opt_state,
         rng=next_rng,
     )
-    return new_state, StepMetrics(loss=loss, examples=den_g, grad_norm=gnorm)
+    return new_state, StepMetrics(loss=loss, examples=den_g, grad_norm=gnorm,
+                                  drop_fraction=drop_fraction)
 
 
 def make_train_step(
@@ -404,8 +461,9 @@ def make_train_epoch_fused(
         per_shard_mb = mini_batch
 
     def _val_loss(state: TrainState, vb: DataBatch) -> jax.Array:
-        preds, _, _ = _forward(
-            apply_fn, state.params, state.model_state, vb.x, train=False
+        preds, _, _, _ = _forward(
+            apply_fn, state.params, state.model_state, vb.x, train=False,
+            example_w=vb.w,
         )
         per = loss_fn(preds, vb.y)
         num = jax.lax.psum(jnp.sum(per * vb.w), axis_names)
@@ -439,6 +497,7 @@ def make_train_epoch_fused(
                 grad_norm=metrics.grad_norm,
                 val_loss=val,
                 active=active,
+                drop_fraction=metrics.drop_fraction,
             )
             return (new_state, new_es), out
 
@@ -475,8 +534,9 @@ def make_eval_step(
     forward of ``distributed.py:166-176``, compiled and collective."""
 
     def shard_eval(state: TrainState, batch: DataBatch):
-        preds, _, _ = _forward(
-            apply_fn, state.params, state.model_state, batch.x, train=False
+        preds, _, _, _ = _forward(
+            apply_fn, state.params, state.model_state, batch.x, train=False,
+            example_w=batch.w,
         )
         per = loss_fn(preds, batch.y)
         num = jax.lax.psum(jnp.sum(per * batch.w), axis_names)

