@@ -284,21 +284,32 @@ def bench_bert_dp() -> dict:
 
 
 def bench_resnet50_inference() -> dict:
-    """BASELINE config 5: ResNet-50 batch inference through
-    BatchPredictor (the partition-parallel inference path).
+    """BASELINE config 5: ResNet-50 batch inference — MEASURED via the
+    columnar-ingest -> device streaming path (Parquet row groups of
+    raw uint8 pixels -> reader thread -> host->device uint8 wire ->
+    normalize + forward + device-side argmax, double-buffered).
 
-    Two numbers: `examples_per_sec_per_chip` is the chip's sustained
-    inference throughput (input already device-resident — what each
-    chip contributes when partitions stream from colocated hosts), and
-    `host_stream_examples_per_sec` is end-to-end from host memory
-    through the double-buffered predict loop. On this dev rig the
-    latter is bound by the tunneled host↔device link (~15 MB/s, vs
-    PCIe on a real pod), so the chip number is the honest hardware
-    metric and the host number a lower bound."""
+    Numbers reported:
+    - `stream_rows_per_sec`: sustained end-to-end rate of THIS run
+      (a few thousand rows so the suite stays fast);
+    - `chip_rate_rows_per_sec_per_chip`: device-resident compute rate
+      (the per-chip ceiling when data streams from colocated hosts);
+    - `ref_100k_*`: the latest >=100k-row measured run from the JSONL
+      log (benchmarks/stream_inference_run.py), when one exists —
+      the honest long-haul number with its 1M projections by basis.
+    On this dev rig the end-to-end rate is bound by the tunneled
+    host<->device link (~6 MB/s effective), not the chip."""
+    import os
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
-    from sparktorch_tpu.inference import BatchPredictor
+    from sparktorch_tpu.inference import (
+        BatchPredictor,
+        stream_parquet_predict,
+        write_rows_parquet,
+    )
     from sparktorch_tpu.models.resnet import resnet50
 
     module = resnet50()
@@ -306,14 +317,19 @@ def bench_resnet50_inference() -> dict:
     chunk = 256
     variables = module.init(jax.random.key(0),
                             np.zeros((1, 224, 224, 3), np.float32))
-    predictor = BatchPredictor(module, variables["params"],
-                               {k: v for k, v in variables.items()
-                                if k != "params"}, chunk=chunk)
-    x = rng.normal(0, 1, (chunk * 4, 224, 224, 3)).astype(np.float32)
+    predictor = BatchPredictor(
+        module, variables["params"],
+        {k: v for k, v in variables.items() if k != "params"},
+        chunk=chunk,
+        preprocess=lambda v: v.astype(jnp.float32) / 255.0,
+        # predict_float argmax on device (torch_distributed.py:112-120)
+        postprocess=lambda y: jnp.argmax(y, -1).astype(jnp.int32),
+    )
+    x = rng.integers(0, 256, (chunk * 4, 224, 224, 3), dtype=np.uint8)
     predictor.predict(x[:chunk])  # compile
     n_chips = len(jax.devices())
 
-    xd = jnp.asarray(x)  # device-resident: measures the chip
+    xd = jax.device_put(x)  # device-resident: measures the chip
     _materialize(xd)
     rates = []
     for _ in range(3):  # best-of-3: the dev tunnel's latency is noisy
@@ -323,23 +339,56 @@ def bench_resnet50_inference() -> dict:
         rates.append(x.shape[0] / (time.perf_counter() - t0))
     per_chip = max(rates) / n_chips
 
-    t0 = time.perf_counter()
-    out = predictor.predict(x)  # host input: transfers included
-    assert out.shape[0] == x.shape[0]
-    host_rate = x.shape[0] / (time.perf_counter() - t0)
+    # End-to-end streaming leg over a real Parquet file (disk ->
+    # decode -> wire -> compute -> drain).
+    n_stream = chunk * 8
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bench_stream.parquet")
+        write_rows_parquet(
+            path,
+            (rng.integers(0, 256, (chunk, 224, 224, 3), dtype=np.uint8)
+             for _ in range(n_stream // chunk)),
+            rows_per_group=chunk,
+        )
+        stats = stream_parquet_predict(
+            predictor, path, row_shape=(224, 224, 3), dtype=np.uint8,
+            batch_rows=4 * chunk,
+        )
 
-    return {
+    out = {
         "config": "resnet50_inference", "unit": "examples/sec/chip",
         "examples_per_sec_per_chip": round(per_chip, 1),
-        "host_stream_examples_per_sec": round(host_rate, 1),
+        "chip_rate_rows_per_sec_per_chip": round(per_chip, 1),
+        "stream_rows_per_sec": stats["rows_per_sec"],
+        "stream_n_rows": stats["n_rows"],
         "n_chips": n_chips,
-        # Renamed from projected_1M_rows_s when the basis changed to
-        # the device-resident chip rate (old rows in the JSONL used
-        # the end-to-end host-stream rate; the two are incomparable).
         "projected_1M_rows_s_chip_rate": round(
             1_000_000 / (per_chip * n_chips), 1
         ),
+        "projected_1M_rows_s_host_stream": round(
+            1_000_000 / max(stats["rows_per_sec"], 1e-9), 1
+        ),
+        "wire_dtype": "uint8 (normalize + argmax fused on device)",
     }
+    # Attach the latest >=100k-row measured run when one was logged.
+    log = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "bench_r03_tpu.jsonl")
+    try:
+        with open(log) as f:
+            runs = [json.loads(line) for line in f if line.strip()]
+        big = [r for r in runs
+               if r.get("config") == "resnet50_inference_stream"
+               and r.get("n_rows", 0) >= 100_000]
+        if big:
+            last = big[-1]
+            out["ref_100k_rows"] = last["n_rows"]
+            out["ref_100k_rows_per_sec"] = last["steady_rows_per_sec"]
+            out["ref_100k_wall_s"] = last["wall_s"]
+    except (OSError, ValueError, KeyError):
+        # Missing log, a truncated line from a killed run, or an
+        # old-schema row — skip the attachment, never the benchmark.
+        pass
+    return out
 
 
 def bench_long_context_lm() -> dict:

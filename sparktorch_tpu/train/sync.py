@@ -241,14 +241,19 @@ def train_distributed(
         if early_stop_patience is not None and early_stop_patience > 0
         else None
     )
-    # Fast path: fuse many steps into one compiled call (lax.scan) when
-    # nothing needs per-step host decisions. With early stopping or a
-    # val forward the default stays at 1 step/call; an EXPLICIT
-    # steps_per_call > 1 keeps exact per-step semantics too — the stop
-    # decision and val forward move inside the fused scan
-    # (make_train_epoch_fused), masking post-stop steps to no-ops.
+    # Fast path: fuse many steps into one compiled call (lax.scan).
+    # Early stopping / the val forward no longer force 1 step/call:
+    # the stop decision and per-iter val forward ride INSIDE the fused
+    # scan (make_train_epoch_fused) with exact per-step semantics —
+    # post-stop steps are masked to no-ops, so the only fusion cost is
+    # the masked tail of the chunk where the stop fires (hence the
+    # smaller default chunk there).
     if steps_per_call is None:
-        steps_per_call = 1 if (stopper is not None or val_batch is not None) else min(iters, 32)
+        steps_per_call = (
+            min(iters, 8)
+            if (stopper is not None or val_batch is not None)
+            else min(iters, 32)
+        )
         if ckpt is not None and checkpoint_every > 0:
             # Keep chunk boundaries at least as frequent as the
             # checkpoint cadence (saves happen between compiled calls).

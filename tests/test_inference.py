@@ -67,6 +67,65 @@ def test_fitted_model_set_mesh(data):
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
 
 
+def test_parquet_streaming_matches_direct(tmp_path, trained):
+    """VERDICT r2 item 2: the columnar-ingest->device streaming path.
+    Rows written as raw fixed-size binary Parquet must stream through
+    the reader thread + double-buffered predictor and match the direct
+    in-memory predict, with uint8 ingest decoded ON DEVICE via the
+    fused preprocess."""
+    import jax.numpy as jnp
+
+    from sparktorch_tpu.inference import (
+        stream_parquet_predict,
+        write_rows_parquet,
+    )
+
+    module, variables = trained
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (777, 10), dtype=np.uint8)
+    path = str(tmp_path / "rows.parquet")
+    n = write_rows_parquet(
+        path, (raw[i : i + 200] for i in range(0, 777, 200)),
+        rows_per_group=128,
+    )
+    assert n == 777
+
+    preprocess = lambda x: x.astype(jnp.float32) / 255.0
+    pred = BatchPredictor(module, variables["params"], chunk=128,
+                          preprocess=preprocess)
+    outs = []
+    stats = stream_parquet_predict(
+        pred, path, row_shape=(10,), dtype=np.uint8,
+        drain=outs.append,
+    )
+    assert stats["n_rows"] == 777
+    assert stats["rows_per_sec"] > 0
+    got = np.concatenate(outs)
+
+    want = BatchPredictor(module, variables["params"], chunk=128).predict(
+        raw.astype(np.float32) / 255.0
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_postprocess_fused(trained):
+    """Device-side postprocess (argmax readback shrink) must match
+    host-side argmax over the raw outputs."""
+    import jax.numpy as jnp
+
+    from sparktorch_tpu.models import MnistMLP
+
+    module = MnistMLP(hidden=(16,), n_classes=4)
+    x = np.random.default_rng(0).normal(0, 1, (300, 10)).astype(np.float32)
+    variables = module.init(jax.random.key(0), x[:1])
+    raw = BatchPredictor(module, variables["params"], chunk=128).predict(x)
+    cls = BatchPredictor(
+        module, variables["params"], chunk=128,
+        postprocess=lambda y: jnp.argmax(y, -1).astype(jnp.int32),
+    ).predict(x)
+    np.testing.assert_array_equal(cls, np.argmax(raw, -1))
+
+
 def test_predictor_device_input_parity():
     # Device-resident input must skip host transfers and match the
     # numpy path bit-for-bit (incl. the ragged last chunk).
