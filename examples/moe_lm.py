@@ -34,6 +34,9 @@ def main():
     cfg = tiny_transformer(
         vocab_size=512, d_model=128, n_heads=4, n_layers=4, d_ff=256,
         max_len=64, n_experts=2 * ep, moe_every=2,
+        # GShard-style top-2 routing: gate-weighted combine over the
+        # two chosen experts, first choices claim capacity first.
+        moe_top_k=2,
     )
     spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
                      optimizer="adamw", optimizer_params={"lr": 3e-4})
@@ -54,8 +57,11 @@ def main():
     batch = shard_batch(batch, mesh)
     for i in range(10):
         state, metrics = step(state, batch)
-        print(f"iter {i} loss {float(metrics.loss):.4f} "
-              f"({cfg.n_experts} experts over ep={ep}, dp={mesh.shape['dp']})")
+        drop = (f" drop={float(metrics.drop_fraction):.3f}"
+                if metrics.drop_fraction is not None else "")
+        print(f"iter {i} loss {float(metrics.loss):.4f}{drop} "
+              f"({cfg.n_experts} experts over ep={ep}, top-2, "
+              f"dp={mesh.shape['dp']})")
 
 
 if __name__ == "__main__":

@@ -52,5 +52,36 @@ def main():
               f"dp={mesh.shape['dp']})")
 
 
+def main_estimator():
+    """The same pipelined training through the ORDINARY estimator
+    surface: pp (and tp) are just a mesh choice. Composes with remat
+    and flash attention; checkpointing works via checkpoint_dir."""
+    from sparktorch_tpu import SparkTorch, serialize_torch_obj
+    from sparktorch_tpu.models.transformer import CausalLM
+
+    n = len(jax.devices())
+    pp = 2 if n % 2 == 0 else 1
+    tp = 2 if n % (pp * 2 * 2) == 0 else 1
+    mesh = build_mesh(MeshConfig(dp=n // (pp * tp), tp=tp, pp=pp))
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=2 * pp,
+        d_ff=256, max_len=64, causal=True, dtype="float32", remat=True,
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (32, cfg.max_len + 1)).astype(np.int32)
+    obj = serialize_torch_obj(
+        CausalLM(cfg), criterion="cross_entropy", optimizer="adamw",
+        optimizer_params={"lr": 3e-4}, input_shape=(cfg.max_len,),
+    )
+    est = SparkTorch(inputCol="features", labelCol="label", torchObj=obj,
+                     iters=10, verbose=1, mesh=mesh, n_micro=8)
+    model = est.fit({"features": list(ids[:, :-1]),
+                     "label": list(ids[:, 1:])})
+    print(f"estimator pp={pp} tp={tp}: trained; "
+          f"final loss {est._last_metrics[-1]['loss']:.4f}")
+    model.transform({"features": list(ids[:8, :-1])})
+
+
 if __name__ == "__main__":
     main()
+    main_estimator()

@@ -381,9 +381,14 @@ def bench_resnet50_inference() -> dict:
                and r.get("n_rows", 0) >= 100_000]
         if big:
             last = big[-1]
-            out["ref_100k_rows"] = last["n_rows"]
-            out["ref_100k_rows_per_sec"] = last["steady_rows_per_sec"]
-            out["ref_100k_wall_s"] = last["wall_s"]
+            # Read every key BEFORE assigning: a partial attachment
+            # from an old-schema row would be worse than none.
+            attach = {
+                "ref_100k_rows": last["n_rows"],
+                "ref_100k_rows_per_sec": last["steady_rows_per_sec"],
+                "ref_100k_wall_s": last["wall_s"],
+            }
+            out.update(attach)
     except (OSError, ValueError, KeyError):
         # Missing log, a truncated line from a killed run, or an
         # old-schema row — skip the attachment, never the benchmark.

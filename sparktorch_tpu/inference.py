@@ -306,9 +306,17 @@ def stream_parquet_predict(
                 # Sentinel-free end detection: a dead reader with an
                 # empty queue is end-of-stream (or a reader crash —
                 # surfaced below) even if its sentinel was dropped.
+                # The reader may have enqueued final items between the
+                # timeout expiring and the liveness check — only an
+                # Empty queue observed AFTER seeing it dead ends the
+                # stream, so nothing enqueued before death is lost.
                 if not t.is_alive():
-                    break
-                continue
+                    try:
+                        item = q.get_nowait()
+                    except _queue.Empty:
+                        break
+                else:
+                    continue
             if item is None:
                 break
             t0 = _time.perf_counter()

@@ -212,7 +212,7 @@ class SparkTorch(Estimator):
                  mode=None, device=None, acquireLock=None, partitionShuffles=None,
                  port=None, useBarrier=None, useVectorOut=None,
                  earlyStopPatience=None, miniBatch=None, validationPct=None,
-                 pushEvery=None, mesh=None, seed=None):
+                 pushEvery=None, mesh=None, seed=None, n_micro=None):
         super().__init__()
         # Defaults mirror torch_distributed.py:178-196.
         self._setDefault(
@@ -235,6 +235,10 @@ class SparkTorch(Estimator):
         self._mesh = kwargs.pop("mesh", None)
         seed = kwargs.pop("seed", None)
         self._seed = 0 if seed is None else int(seed)
+        # GPipe microbatch count — only meaningful when the mesh has
+        # pp>1 (like mesh/seed, a driver-side object, not an ML Param).
+        n_micro = kwargs.pop("n_micro", None)
+        self._n_micro = 4 if n_micro is None else int(n_micro)
         self._set(**kwargs)
 
     @keyword_only
@@ -246,6 +250,10 @@ class SparkTorch(Estimator):
             seed = kwargs.pop("seed")
             if seed is not None:
                 self._seed = int(seed)
+        if "n_micro" in kwargs:
+            n_micro = kwargs.pop("n_micro")
+            if n_micro is not None:
+                self._n_micro = int(n_micro)
         return self._set(**kwargs)
 
     # -- getters (torch_distributed.py:224-264 parity) ----------------------
@@ -331,6 +339,7 @@ class SparkTorch(Estimator):
                 early_stop_patience=self.getEarlyStopPatience(),
                 seed=self._seed,
                 device=self.getDevice(),
+                n_micro=self._n_micro,
             )
         elif mode in ("hogwild", "async"):
             from sparktorch_tpu.train.hogwild import train_async

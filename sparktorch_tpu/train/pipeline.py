@@ -229,16 +229,29 @@ def _param_specs(params) -> Any:
 
 def place_pipeline_state(params, tx, mesh: Mesh) -> PipelineState:
     """device_put params into their pipeline layout and init the
-    optimizer on the placed arrays (eager optax init preserves input
-    shardings leaf-wise)."""
+    optimizer on the placed arrays. EVERY leaf (incl. optimizer
+    scalars and the step counter) gets an explicit mesh-wide
+    sharding: eager optax init would otherwise leave scalar leaves on
+    one device, and a checkpoint restored against those shardings
+    could not feed the pp shard_map step."""
+    specs = _param_specs(params)
     sh = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), _param_specs(params),
+        lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
     params = jax.tree.map(jax.device_put, params, sh)
     opt_state = tx.init(params)
-    return PipelineState(step=jnp.zeros((), jnp.int32), params=params,
-                         opt_state=opt_state)
+    opt_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), _opt_specs(tx, opt_state, specs),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    opt_state = jax.tree.map(jax.device_put, opt_state, opt_sh)
+    return PipelineState(
+        step=jax.device_put(jnp.zeros((), jnp.int32),
+                            NamedSharding(mesh, P())),
+        params=params,
+        opt_state=opt_state,
+    )
 
 
 def make_pp_train_step(
@@ -467,6 +480,9 @@ def train_distributed_pipeline(
     verbose: int = 0,
     seed: int = 0,
     metrics_hook=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -532,22 +548,40 @@ def train_distributed_pipeline(
     flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
     pparams = pipeline_params_from_flax(flax_params, cfg.n_layers)
     state = place_pipeline_state(pparams, tx, mesh)
+
+    from sparktorch_tpu.train.sync import (
+        _finalize_checkpoint,
+        _open_checkpoint,
+        _save_if_due,
+    )
+
+    # PipelineState checkpoints like TrainState (step-indexed orbax
+    # snapshots restored INTO the pp/tp-sharded layout).
+    ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
     step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro)
 
     recorder = MetricsRecorder(n_chips=mesh.size)
-    for i in range(iters):
-        t0 = time.perf_counter()
-        state, loss = step(state, batch)
-        record = {
-            "round": 0, "iter": i, "loss": float(loss), "val_loss": None,
-            "examples": float(n), "grad_norm": float("nan"),
-            "step_time_s": time.perf_counter() - t0,
-        }
-        recorder.record(record)
-        if metrics_hook:
-            metrics_hook(record)
-        if verbose:
-            print(f"[sparktorch_tpu:pp] iter {i} loss {float(loss):.6f}")
+    last_ckpt = int(jax.device_get(state.step)) if ckpt is not None else 0
+    start = int(jax.device_get(state.step))
+    completed = False
+    try:
+        for i in range(start, start + iters):
+            t0 = time.perf_counter()
+            state, loss = step(state, batch)
+            record = {
+                "round": 0, "iter": i, "loss": float(loss), "val_loss": None,
+                "examples": float(n), "grad_norm": float("nan"),
+                "step_time_s": time.perf_counter() - t0,
+            }
+            recorder.record(record)
+            if metrics_hook:
+                metrics_hook(record)
+            if verbose:
+                print(f"[sparktorch_tpu:pp] iter {i} loss {float(loss):.6f}")
+            last_ckpt = _save_if_due(ckpt, state, last_ckpt, checkpoint_every)
+        completed = True
+    finally:
+        _finalize_checkpoint(ckpt, state, completed)
 
     trained = jax.device_get(state.params)
     out_params = flax_params_from_pipeline(trained, cfg.n_layers)

@@ -152,13 +152,15 @@ def train_distributed(
     resume: bool = False,
     profile_dir: Optional[str] = None,
     pre_sharded: bool = False,
+    n_micro: int = 4,
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
     Parameter surface mirrors ``train_distributed``
     (``distributed.py:209-236``): iters, partition_shuffles, verbose,
     mini_batch, validation_pct, early_stop_patience. ``world_size`` and
-    ``device`` disappear — the mesh defines the world.
+    ``device`` disappear — the mesh defines the world. ``n_micro``
+    applies only when the mesh has pp>1 (GPipe microbatch count).
     """
     del device
     spec = deserialize_model(torch_obj)
@@ -177,15 +179,13 @@ def train_distributed(
             "mini_batch (n_micro microbatching covers it)": bool(mini_batch),
             "partition_shuffles": partition_shuffles > 1,
             "steps_per_call": steps_per_call is not None,
-            "checkpoint_dir": bool(checkpoint_dir),
-            "resume": resume,
             "profile_dir": bool(profile_dir),
             "pre_sharded": pre_sharded,
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
-            # Fail loudly: silently dropping e.g. checkpoint_dir would
-            # lose data on resume.
+            # Fail loudly: silently dropping a knob would surprise in
+            # exactly the ways that lose data or training signal.
             raise ValueError(
                 f"not supported with pp>1 yet: {', '.join(bad)}"
             )
@@ -193,7 +193,9 @@ def train_distributed(
 
         return train_distributed_pipeline(
             spec, data, labels=labels, mesh=mesh, iters=iters,
-            verbose=verbose, seed=seed, metrics_hook=metrics_hook,
+            n_micro=n_micro, verbose=verbose, seed=seed,
+            metrics_hook=metrics_hook, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every, resume=resume,
         )
 
     if pre_sharded:
