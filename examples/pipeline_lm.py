@@ -68,13 +68,18 @@ def main_estimator():
         d_ff=256, max_len=64, causal=True, dtype="float32", remat=True,
     )
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, 512, (32, cfg.max_len + 1)).astype(np.int32)
+    # Batch sized from the mesh: each dp shard needs a multiple of
+    # n_micro rows (the trainer pads ragged inputs, but an exact fit
+    # demonstrates the intended shape).
+    n_micro = 4
+    b = mesh.shape["dp"] * n_micro * 2
+    ids = rng.integers(0, 512, (b, cfg.max_len + 1)).astype(np.int32)
     obj = serialize_torch_obj(
         CausalLM(cfg), criterion="cross_entropy", optimizer="adamw",
         optimizer_params={"lr": 3e-4}, input_shape=(cfg.max_len,),
     )
     est = SparkTorch(inputCol="features", labelCol="label", torchObj=obj,
-                     iters=10, verbose=1, mesh=mesh, n_micro=8)
+                     iters=10, verbose=1, mesh=mesh, n_micro=n_micro)
     model = est.fit({"features": list(ids[:, :-1]),
                      "label": list(ids[:, 1:])})
     print(f"estimator pp={pp} tp={tp}: trained; "

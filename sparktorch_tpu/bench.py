@@ -531,7 +531,17 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     names = list(CONFIGS) if args.config == "all" else [args.config]
     records = []
-    for name in names:
+    for i, name in enumerate(names):
+        if i:
+            # Fresh device/executable state per config: carried-over
+            # compiled programs and live buffers from earlier configs
+            # measurably depress later ones (~20-25% on the CNN
+            # config); with the persistent compile cache on disk,
+            # clearing costs little.
+            import gc
+
+            jax.clear_caches()
+            gc.collect()
         rec = CONFIGS[name]()
         rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
         records.append(rec)

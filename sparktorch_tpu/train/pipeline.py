@@ -182,6 +182,30 @@ def init_pipeline_lm(cfg: TransformerConfig, key: jax.Array):
     return params
 
 
+def init_pipeline_classifier(cfg: TransformerConfig, key: jax.Array):
+    """Pipeline layout of the BERT-style ``SequenceClassifier``: same
+    stacked layers + embedding, with a pooler (tanh) + classifier head
+    instead of the LM head."""
+    layer = EncoderLayer(cfg)
+    k_embed, k_pos, k_pool, k_cls, k_layers = jax.random.split(key, 5)
+    sample_h = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.compute_dtype)
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    stacked = jax.vmap(lambda k: layer.init(k, sample_h)["params"])(layer_keys)
+    d = cfg.d_model
+    return {
+        "layers": stacked,
+        "tok_embed": jax.random.normal(k_embed, (cfg.vocab_size, d)) * 0.02,
+        "pos_embed": jax.random.normal(k_pos, (cfg.max_len, d)) * 0.02,
+        "ln_scale": jnp.ones((d,)),
+        "ln_bias": jnp.zeros((d,)),
+        "pool_w": jax.random.normal(k_pool, (d, d)) * (1.0 / np.sqrt(d)),
+        "pool_b": jnp.zeros((d,)),
+        "cls_w": jax.random.normal(k_cls, (d, cfg.n_classes))
+        * (1.0 / np.sqrt(d)),
+        "cls_b": jnp.zeros((cfg.n_classes,)),
+    }
+
+
 # Per-leaf tp sharding of the stacked layer tree, keyed by the dim the
 # head/column slice lives on (after the leading layer-stack dim).
 _TP_LAYER_DIMS = {
@@ -259,9 +283,16 @@ def make_pp_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     n_micro: int,
+    head: str = "lm",
 ) -> Callable[[PipelineState, DataBatch], Tuple[PipelineState, jax.Array]]:
     """Build the jitted pipelined train step over ``mesh`` (dp x pp x
-    tp; other axes must be 1 for this trainer)."""
+    tp; other axes must be 1 for this trainer).
+
+    ``head``: ``'lm'`` (next-token CE over the vocab, causal) or
+    ``'classifier'`` (BERT-style pooler + class CE — the config-4
+    workload, pipelined)."""
+    if head not in ("lm", "classifier"):
+        raise ValueError(f"unknown head {head!r}")
     for ax in mesh.shape:
         if ax not in (AXIS_DP, AXIS_PP, AXIS_TP) and mesh.shape[ax] != 1:
             raise ValueError(
@@ -289,7 +320,8 @@ def make_pp_train_step(
             "pipeline trainer supports attn_impl 'dense' or 'flash' "
             "(ring attention's shard_map island does not nest)"
         )
-    cfg = dataclasses.replace(cfg, causal=True)
+    if head == "lm":
+        cfg = dataclasses.replace(cfg, causal=True)
     dt = cfg.compute_dtype
 
     def stage_fn(local_layers, h):
@@ -311,9 +343,23 @@ def make_pp_train_step(
     def head_loss(params, h, y, w):
         hf = _ln({"scale": params["ln_scale"], "bias": params["ln_bias"]},
                  h, jnp.float32)
-        logits = hf @ params["head_w"] + params["head_b"]
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-        per_ex = per_tok.mean(-1)
+        if head == "classifier":
+            # Pooler in the model's compute dtype, classifier logits in
+            # f32 — matching the flax SequenceClassifier exactly
+            # (transformer.py: pooler Dense dtype=compute_dtype,
+            # classifier Dense dtype=float32), so pp-trained params see
+            # the same numerics the module applies at transform time.
+            pooled = jnp.tanh(
+                hf.astype(dt).mean(1) @ params["pool_w"].astype(dt)
+                + params["pool_b"].astype(dt)
+            )
+            logits = (pooled.astype(jnp.float32) @ params["cls_w"]
+                      + params["cls_b"])
+            per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        else:
+            logits = hf @ params["head_w"] + params["head_b"]
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            per_ex = per_tok.mean(-1)
         return jnp.sum(per_ex * w), jnp.sum(w)
 
     ring = [(i, (i + 1) % S) for i in range(S)]
@@ -327,7 +373,8 @@ def make_pp_train_step(
             )
         mb = b_local // n_micro
         micro_x = x.reshape(n_micro, mb, s)
-        micro_y = y.reshape(n_micro, mb, s)
+        # lm targets are token-level (b, s); classifier labels (b,).
+        micro_y = y.reshape((n_micro, mb) + y.shape[1:])
         micro_w = w.reshape(n_micro, mb)
 
         def pipeline_loss(params):
@@ -436,26 +483,33 @@ def _opt_specs(tx, opt_state, param_specs):
 
 
 def pipeline_params_from_flax(params, n_layers: int):
-    """Convert a ``CausalLM`` (untied) flax param tree into the
-    pipeline's stacked layout. Inverse of
+    """Convert a ``CausalLM`` (untied) or ``SequenceClassifier`` flax
+    param tree into the pipeline's stacked layout. Inverse of
     :func:`flax_params_from_pipeline`."""
     bb = params["backbone"]
     layer_trees = [bb[f"layer_{i}"] for i in range(n_layers)]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_trees)
-    return {
+    out = {
         "layers": stacked,
         "tok_embed": bb["tok_embed"]["embedding"],
         "pos_embed": bb["pos_embed"],
         "ln_scale": bb["ln_final"]["scale"],
         "ln_bias": bb["ln_final"]["bias"],
-        "head_w": params["lm_head"]["kernel"],
-        "head_b": params["lm_head"]["bias"],
     }
+    if "lm_head" in params:
+        out["head_w"] = params["lm_head"]["kernel"]
+        out["head_b"] = params["lm_head"]["bias"]
+    else:
+        out["pool_w"] = params["pooler"]["kernel"]
+        out["pool_b"] = params["pooler"]["bias"]
+        out["cls_w"] = params["classifier"]["kernel"]
+        out["cls_b"] = params["classifier"]["bias"]
+    return out
 
 
 def flax_params_from_pipeline(pparams, n_layers: int):
-    """Back to the ``CausalLM`` flax tree (so the fitted bundle
-    transforms through the ordinary module apply)."""
+    """Back to the ``CausalLM`` / ``SequenceClassifier`` flax tree (so
+    the fitted bundle transforms through the ordinary module apply)."""
     bb = {
         f"layer_{i}": jax.tree.map(lambda a: a[i], pparams["layers"])
         for i in range(n_layers)
@@ -464,9 +518,16 @@ def flax_params_from_pipeline(pparams, n_layers: int):
     bb["pos_embed"] = pparams["pos_embed"]
     bb["ln_final"] = {"scale": pparams["ln_scale"],
                       "bias": pparams["ln_bias"]}
+    if "head_w" in pparams:
+        return {
+            "backbone": bb,
+            "lm_head": {"kernel": pparams["head_w"],
+                        "bias": pparams["head_b"]},
+        }
     return {
         "backbone": bb,
-        "lm_head": {"kernel": pparams["head_w"], "bias": pparams["head_b"]},
+        "pooler": {"kernel": pparams["pool_w"], "bias": pparams["pool_b"]},
+        "classifier": {"kernel": pparams["cls_w"], "bias": pparams["cls_b"]},
     }
 
 
@@ -496,23 +557,27 @@ def train_distributed_pipeline(
     """
     import time
 
-    from sparktorch_tpu.models.transformer import CausalLM
+    from sparktorch_tpu.models.transformer import CausalLM, SequenceClassifier
     from sparktorch_tpu.train.sync import TrainResult
     from sparktorch_tpu.utils.metrics import MetricsRecorder
 
     module = spec.make_module()
-    if not isinstance(module, CausalLM):
+    if isinstance(module, CausalLM):
+        head = "lm"
+    elif isinstance(module, SequenceClassifier):
+        head = "classifier"
+    else:
         raise ValueError(
-            "pipeline-parallel training (mesh pp>1) currently supports "
-            f"CausalLM specs; got {type(module).__name__}. Use a mesh "
-            "with pp=1 for other model families."
+            "pipeline-parallel training (mesh pp>1) supports CausalLM "
+            f"and SequenceClassifier specs; got {type(module).__name__}. "
+            "Use a mesh with pp=1 for other model families."
         )
     cfg = module.config
     if cfg.tie_embeddings:
         raise ValueError("pp training does not support tie_embeddings yet")
     if spec.loss not in ("cross_entropy", "cross_entropy_fused", "nll"):
         raise ValueError(
-            f"pp training uses token-level cross entropy; got {spec.loss!r}"
+            f"pp training uses cross entropy; got {spec.loss!r}"
         )
 
     if isinstance(data, DataBatch):
@@ -527,8 +592,10 @@ def train_distributed_pipeline(
     else:
         x = np.asarray(data)
         y = np.asarray(labels) if labels is not None else None
-        if y is None:  # next-token LM on a single id matrix
-            x, y = x[:, :-1], x[:, 1:]
+        if y is None:
+            if head == "classifier":
+                raise ValueError("classifier pp training requires labels")
+            x, y = x[:, :-1], x[:, 1:]  # next-token LM on one id matrix
         w = np.ones((x.shape[0],), np.float32)
     x = x.astype(np.int32)
     y = y.astype(np.int32)
@@ -558,7 +625,7 @@ def train_distributed_pipeline(
     # PipelineState checkpoints like TrainState (step-indexed orbax
     # snapshots restored INTO the pp/tp-sharded layout).
     ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
-    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro)
+    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head)
 
     recorder = MetricsRecorder(n_chips=mesh.size)
     last_ckpt = int(jax.device_get(state.step)) if ckpt is not None else 0
