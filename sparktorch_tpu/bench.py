@@ -30,6 +30,7 @@ CLI: ``sparktorch-tpu-bench [--config all|headline|<name>] [--log PATH]``.
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import time
 from typing import Callable, Dict, List, Optional
@@ -538,8 +539,6 @@ def main(argv: Optional[List[str]] = None) -> None:
             # measurably depress later ones (~20-25% on the CNN
             # config); with the persistent compile cache on disk,
             # clearing costs little.
-            import gc
-
             jax.clear_caches()
             gc.collect()
         rec = CONFIGS[name]()

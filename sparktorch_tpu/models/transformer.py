@@ -71,6 +71,16 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    def moe_pattern(self):
+        """Per-layer use_moe flags — THE layer schedule, shared by the
+        flax ``Transformer`` stack and the pipeline trainer's stacked
+        layout (they must agree or restacked params would silently
+        swap kinds)."""
+        return [
+            self.n_experts > 0 and (i + 1) % max(1, self.moe_every) == 0
+            for i in range(self.n_layers)
+        ]
+
     @property
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
@@ -302,10 +312,7 @@ class Transformer(nn.Module):
         layer = EncoderLayer
         if cfg.remat:
             layer = nn.remat(EncoderLayer)
-        for i in range(cfg.n_layers):
-            use_moe = (
-                cfg.n_experts > 0 and (i + 1) % max(1, cfg.moe_every) == 0
-            )
+        for i, use_moe in enumerate(cfg.moe_pattern()):
             x = layer(cfg, use_moe=use_moe, name=f"layer_{i}")(x, token_w)
         return nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_final")(x)
 

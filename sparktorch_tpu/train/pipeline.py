@@ -157,28 +157,53 @@ def _layer_forward(cfg: TransformerConfig, lp, h):
     return x + _tp_reduce(mid @ w2) + b2
 
 
-def init_pipeline_lm(cfg: TransformerConfig, key: jax.Array):
-    """Host-side init of a causal LM laid out for pipelining: the
-    encoder layers' params are STACKED on a leading (n_layers) dim —
-    the dim the pp sharding splits — plus replicated embedding / final
-    norm / LM head tensors."""
-    cfg = dataclasses.replace(cfg, causal=True)
-    layer = EncoderLayer(cfg)
-    k_embed, k_pos, k_head, k_layers = jax.random.split(key, 4)
+def _moe_pattern(cfg: TransformerConfig):
+    """Per-layer use_moe flags — delegates to the ONE schedule
+    definition on the config (shared with the flax Transformer)."""
+    return cfg.moe_pattern()
+
+
+def _stacked_layer_init(cfg, key, use_moe: bool, n: int):
+    layer = EncoderLayer(cfg, use_moe=use_moe)
     sample_h = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.compute_dtype)
-    layer_keys = jax.random.split(k_layers, cfg.n_layers)
-    stacked = jax.vmap(lambda k: layer.init(k, sample_h)["params"])(layer_keys)
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: layer.init(k, sample_h)["params"])(keys)
+
+
+def _init_backbone(cfg: TransformerConfig, k_embed, k_pos, k_dense, k_moe):
+    """Shared pipeline backbone init: embeddings, final norm, and the
+    dense / MoE layer stacks (separate stacks — their trees differ;
+    each pp-sharded on its leading layer dim)."""
+    pattern = _moe_pattern(cfg)
+    n_dense = pattern.count(False)
+    n_moe = pattern.count(True)
     d = cfg.d_model
     params = {
-        "layers": stacked,  # every leaf: (n_layers, ...)
         "tok_embed": jax.random.normal(k_embed, (cfg.vocab_size, d)) * 0.02,
         "pos_embed": jax.random.normal(k_pos, (cfg.max_len, d)) * 0.02,
         "ln_scale": jnp.ones((d,)),
         "ln_bias": jnp.zeros((d,)),
-        "head_w": jax.random.normal(k_head, (d, cfg.vocab_size))
-        * (1.0 / np.sqrt(d)),
-        "head_b": jnp.zeros((cfg.vocab_size,)),
     }
+    if n_dense:
+        params["layers"] = _stacked_layer_init(cfg, k_dense, False, n_dense)
+    if n_moe:
+        params["layers_moe"] = _stacked_layer_init(cfg, k_moe, True, n_moe)
+    return params
+
+
+def init_pipeline_lm(cfg: TransformerConfig, key: jax.Array):
+    """Host-side init of a causal LM laid out for pipelining: the
+    encoder layers' params are STACKED on a leading (layer) dim — the
+    dim the pp sharding splits — plus replicated embedding / final
+    norm / LM head tensors."""
+    cfg = dataclasses.replace(cfg, causal=True)
+    k_embed, k_pos, k_head, k_dense, k_moe = jax.random.split(key, 5)
+    d = cfg.d_model
+    params = _init_backbone(cfg, k_embed, k_pos, k_dense, k_moe)
+    params["head_w"] = jax.random.normal(k_head, (d, cfg.vocab_size)) * (
+        1.0 / np.sqrt(d)
+    )
+    params["head_b"] = jnp.zeros((cfg.vocab_size,))
     return params
 
 
@@ -186,24 +211,16 @@ def init_pipeline_classifier(cfg: TransformerConfig, key: jax.Array):
     """Pipeline layout of the BERT-style ``SequenceClassifier``: same
     stacked layers + embedding, with a pooler (tanh) + classifier head
     instead of the LM head."""
-    layer = EncoderLayer(cfg)
-    k_embed, k_pos, k_pool, k_cls, k_layers = jax.random.split(key, 5)
-    sample_h = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.compute_dtype)
-    layer_keys = jax.random.split(k_layers, cfg.n_layers)
-    stacked = jax.vmap(lambda k: layer.init(k, sample_h)["params"])(layer_keys)
+    k_embed, k_pos, k_pool, k_cls, k_dense, k_moe = jax.random.split(key, 6)
     d = cfg.d_model
-    return {
-        "layers": stacked,
-        "tok_embed": jax.random.normal(k_embed, (cfg.vocab_size, d)) * 0.02,
-        "pos_embed": jax.random.normal(k_pos, (cfg.max_len, d)) * 0.02,
-        "ln_scale": jnp.ones((d,)),
-        "ln_bias": jnp.zeros((d,)),
-        "pool_w": jax.random.normal(k_pool, (d, d)) * (1.0 / np.sqrt(d)),
-        "pool_b": jnp.zeros((d,)),
-        "cls_w": jax.random.normal(k_cls, (d, cfg.n_classes))
-        * (1.0 / np.sqrt(d)),
-        "cls_b": jnp.zeros((cfg.n_classes,)),
-    }
+    params = _init_backbone(cfg, k_embed, k_pos, k_dense, k_moe)
+    params["pool_w"] = jax.random.normal(k_pool, (d, d)) * (1.0 / np.sqrt(d))
+    params["pool_b"] = jnp.zeros((d,))
+    params["cls_w"] = jax.random.normal(k_cls, (d, cfg.n_classes)) * (
+        1.0 / np.sqrt(d)
+    )
+    params["cls_b"] = jnp.zeros((cfg.n_classes,))
+    return params
 
 
 # Per-leaf tp sharding of the stacked layer tree, keyed by the dim the
@@ -231,8 +248,9 @@ def _layer_leaf_spec(path_names: Tuple[str, ...], ndim: int) -> P:
 
 def _param_specs(params) -> Any:
     """Per-leaf PartitionSpecs: layer stacks split over pp on their
-    leading (layer) dim and over tp on head/column dims; everything
-    else replicated."""
+    leading (layer) dim and over tp on head/column dims; MoE layer
+    stacks split over pp only (experts replicated within a stage — tp
+    is rejected with MoE); everything else replicated."""
     from jax.tree_util import tree_map_with_path
 
     def layers_spec(path, leaf):
@@ -245,6 +263,8 @@ def _param_specs(params) -> Any:
         k: (
             tree_map_with_path(layers_spec, v)
             if k == "layers"
+            else jax.tree.map(lambda _: P(AXIS_PP), v)
+            if k == "layers_moe"
             else jax.tree.map(lambda _: P(), v)
         )
         for k, v in params.items()
@@ -306,13 +326,28 @@ def make_pp_train_step(
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={T}")
     if cfg.d_ff % max(1, T) != 0:
         raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={T}")
-    # The pipelined stack is the homogeneous dense EncoderLayer; fail
-    # loudly rather than silently training a different model.
-    if cfg.n_experts > 0:
-        raise ValueError(
-            "pipeline trainer does not support MoE layers (heterogeneous "
-            "stage stacks); use the GSPMD sharded trainer for MoE"
-        )
+    # MoE composes when every stage sees the SAME dense/MoE layer
+    # pattern (the two layer kinds live in separate pp-sharded
+    # stacks); experts replicate within a stage — expert PARALLELISM
+    # stays the GSPMD trainer's ep axis.
+    pattern = _moe_pattern(cfg)
+    has_moe = any(pattern)
+    if has_moe:
+        if T > 1:
+            raise ValueError(
+                "pp x tp with MoE layers is not supported (experts "
+                "replicate within a stage); use tp=1, or the GSPMD "
+                "sharded trainer with the ep axis for expert parallelism"
+            )
+        lps = cfg.n_layers // max(1, S)
+        stage_patterns = [pattern[s * lps:(s + 1) * lps] for s in range(S)]
+        if any(sp != stage_patterns[0] for sp in stage_patterns):
+            raise ValueError(
+                f"MoE layer pattern {pattern} is not uniform across "
+                f"pp={S} stages; choose moe_every/n_layers so every "
+                "stage holds the same dense/MoE sequence"
+            )
+        stage_pattern = stage_patterns[0]
     if cfg.attn_impl == "ring":
         # ring opens its own shard_map island, which does not compose
         # with the pp shard_map schedule.
@@ -324,16 +359,59 @@ def make_pp_train_step(
         cfg = dataclasses.replace(cfg, causal=True)
     dt = cfg.compute_dtype
 
-    def stage_fn(local_layers, h):
-        layer_fwd = lambda lp, h: _layer_forward(cfg, lp, h)
-        if cfg.remat:
-            layer_fwd = jax.checkpoint(layer_fwd)
+    layer_fwd = lambda lp, h: _layer_forward(cfg, lp, h)
+    if cfg.remat:
+        layer_fwd = jax.checkpoint(layer_fwd)
 
+    def stage_fn(local_layers, h):
         def body(h, lp):
             return layer_fwd(lp, h), None
 
         h, _ = jax.lax.scan(body, h, local_layers)
         return h
+
+    if has_moe:
+        from sparktorch_tpu.train.step import _moe_drop_counts
+
+        moe_layer = EncoderLayer(cfg, use_moe=True)
+
+        def moe_apply(lp, h, token_w):
+            out, sown = moe_layer.apply(
+                {"params": lp}, h, token_w,
+                mutable=["losses", "moe_metrics"],
+            )
+            aux = jnp.zeros((), jnp.float32)
+            for leaf in jax.tree.leaves(sown.get("losses", {})):
+                aux = aux + jnp.sum(leaf).astype(jnp.float32)
+            counts = _moe_drop_counts(sown.get("moe_metrics"))
+            dropped, routed = counts if counts is not None else (
+                jnp.zeros(()), jnp.zeros(())
+            )
+            return out, aux, dropped, routed
+
+        if cfg.remat:
+            moe_apply = jax.checkpoint(moe_apply)
+
+        def stage_fn_moe(params, h, token_w):
+            """Unrolled stage walk over the per-stage pattern, picking
+            each layer's params from its kind's pp-sharded stack."""
+            aux = jnp.zeros((), jnp.float32)
+            dropped = jnp.zeros((), jnp.float32)
+            routed = jnp.zeros((), jnp.float32)
+            jd = jm = 0
+            for is_moe in stage_pattern:
+                if is_moe:
+                    lp = jax.tree.map(lambda a: a[jm], params["layers_moe"])
+                    h, a, dr, rt = moe_apply(lp, h, token_w)
+                    aux = aux + a
+                    dropped = dropped + dr
+                    routed = routed + rt
+                    jm += 1
+                else:
+                    lp = jax.tree.map(lambda a: a[jd], params["layers"])
+                    h = layer_fwd(lp, h)
+                    jd += 1
+            return h, aux, dropped, routed
 
     def embed(params, ids):
         s = ids.shape[1]
@@ -379,7 +457,7 @@ def make_pp_train_step(
 
         def pipeline_loss(params):
             def tick(carry, t):
-                h_prev, num, den = carry
+                h_prev, num, den, aux, dropped, routed = carry
                 inj = jnp.clip(t, 0, n_micro - 1)
                 # Only stage 0 embeds and only the last stage (inside
                 # its valid drain window) runs the vocab-sized head —
@@ -391,7 +469,26 @@ def make_pp_train_step(
                     lambda: embed(params, micro_x[inj]),
                     lambda: h_prev,
                 )
-                h_out = stage_fn(params["layers"], h_in)
+                if has_moe:
+                    # The microbatch THIS stage processes at tick t was
+                    # injected at t - stage; bubble ticks (no valid
+                    # microbatch) get all-zero token weights so their
+                    # garbage activations never touch routing, capacity
+                    # or the aux loss.
+                    m_in = t - stage
+                    mi_in = jnp.clip(m_in, 0, n_micro - 1)
+                    valid_in = ((m_in >= 0) & (m_in < n_micro)).astype(
+                        micro_w.dtype
+                    )
+                    tw = jnp.broadcast_to(
+                        (micro_w[mi_in] * valid_in)[:, None], (mb, s)
+                    )
+                    h_out, aux_t, dr_t, rt_t = stage_fn_moe(params, h_in, tw)
+                    aux = aux + aux_t
+                    dropped = dropped + dr_t
+                    routed = routed + rt_t
+                else:
+                    h_out = stage_fn(params["layers"], h_in)
                 m = t - (S - 1)
                 mi = jnp.clip(m, 0, n_micro - 1)
                 use = (m >= 0) & (m < n_micro) & (stage == S - 1)
@@ -403,18 +500,36 @@ def make_pp_train_step(
                 num = num + n_
                 den = den + d_
                 h_next = jax.lax.ppermute(h_out, AXIS_PP, ring)
-                return (h_next, num, den), None
+                return (h_next, num, den, aux, dropped, routed), None
 
             init_h = jnp.zeros((mb, s, cfg.d_model), dt)
-            (_, num, den), _ = jax.lax.scan(
-                tick, (init_h, jnp.zeros(()), jnp.zeros(())),
+            zero = jnp.zeros(())
+            (_, num, den, aux, dropped, routed), _ = jax.lax.scan(
+                tick,
+                (init_h, zero, zero, zero, zero, zero),
                 jnp.arange(n_micro + S - 1),
             )
             num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
             den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
-            return num_g / jnp.maximum(den_g, 1.0)
+            loss = num_g / jnp.maximum(den_g, 1.0)
+            if has_moe:
+                # Sum over stages/layers (psum pp — stages hold
+                # disjoint MoE layers), mean over microbatches and dp
+                # shards: the pipelined analog of the GSPMD trainer's
+                # batch-mean sown aux.
+                aux_g = jax.lax.psum(aux, (AXIS_PP, AXIS_DP))
+                dp_n = jax.lax.axis_size(AXIS_DP)
+                loss = loss + aux_g / (n_micro * dp_n)
+                dropped_g = jax.lax.psum(dropped, (AXIS_PP, AXIS_DP))
+                routed_g = jax.lax.psum(routed, (AXIS_PP, AXIS_DP))
+                drop_fraction = dropped_g / jnp.maximum(routed_g, 1.0)
+            else:
+                drop_fraction = jnp.zeros(())
+            return loss, drop_fraction
 
-        loss, grads = jax.value_and_grad(pipeline_loss)(params)
+        (loss, drop_fraction), grads = jax.value_and_grad(
+            pipeline_loss, has_aux=True
+        )(params)
         # Replicated-param grads must be summed over every axis the
         # param is replicated across: layer stacks live on one pp
         # shard each (sum over dp only); embed/head/norm are used on
@@ -425,7 +540,7 @@ def make_pp_train_step(
         grads = {
             k: (
                 jax.tree.map(lambda g: jax.lax.psum(g, AXIS_DP), v)
-                if k == "layers"
+                if k in ("layers", "layers_moe")
                 else jax.tree.map(
                     lambda g: jax.lax.psum(g, (AXIS_PP, AXIS_DP)), v
                 )
@@ -434,7 +549,7 @@ def make_pp_train_step(
         }
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt, loss
+        return new_params, new_opt, loss, drop_fraction
 
     cache = {}
 
@@ -447,12 +562,16 @@ def make_pp_train_step(
                 mesh,
                 in_specs=(specs, opt_specs,
                           P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
-                out_specs=(specs, opt_specs, P()),
+                out_specs=(specs, opt_specs, P(), P()),
             )
             cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
-        new_params, new_opt, loss = cache["jitted"](
+        new_params, new_opt, loss, drop = cache["jitted"](
             state.params, state.opt_state, batch.x, batch.y, batch.w
         )
+        # Introspection hook (concrete post-jit value): the MoE
+        # capacity-drop fraction for this step; the training entry
+        # records it as moe_drop_fraction like the other trainers.
+        step.last_drop_fraction = float(drop) if has_moe else None
         return (
             PipelineState(step=state.step + 1, params=new_params,
                           opt_state=new_opt),
@@ -482,20 +601,25 @@ def _opt_specs(tx, opt_state, param_specs):
 # ---------------------------------------------------------------------------
 
 
-def pipeline_params_from_flax(params, n_layers: int):
+def pipeline_params_from_flax(params, cfg: TransformerConfig):
     """Convert a ``CausalLM`` (untied) or ``SequenceClassifier`` flax
-    param tree into the pipeline's stacked layout. Inverse of
+    param tree into the pipeline's stacked layout (dense and MoE
+    layers into their separate stacks). Inverse of
     :func:`flax_params_from_pipeline`."""
     bb = params["backbone"]
-    layer_trees = [bb[f"layer_{i}"] for i in range(n_layers)]
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_trees)
+    pattern = _moe_pattern(cfg)
     out = {
-        "layers": stacked,
         "tok_embed": bb["tok_embed"]["embedding"],
         "pos_embed": bb["pos_embed"],
         "ln_scale": bb["ln_final"]["scale"],
         "ln_bias": bb["ln_final"]["bias"],
     }
+    dense = [bb[f"layer_{i}"] for i in range(cfg.n_layers) if not pattern[i]]
+    moe = [bb[f"layer_{i}"] for i in range(cfg.n_layers) if pattern[i]]
+    if dense:
+        out["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *dense)
+    if moe:
+        out["layers_moe"] = jax.tree.map(lambda *xs: jnp.stack(xs), *moe)
     if "lm_head" in params:
         out["head_w"] = params["lm_head"]["kernel"]
         out["head_b"] = params["lm_head"]["bias"]
@@ -507,13 +631,25 @@ def pipeline_params_from_flax(params, n_layers: int):
     return out
 
 
-def flax_params_from_pipeline(pparams, n_layers: int):
+def flax_params_from_pipeline(pparams, cfg: TransformerConfig):
     """Back to the ``CausalLM`` / ``SequenceClassifier`` flax tree (so
     the fitted bundle transforms through the ordinary module apply)."""
-    bb = {
-        f"layer_{i}": jax.tree.map(lambda a: a[i], pparams["layers"])
-        for i in range(n_layers)
-    }
+    pattern = _moe_pattern(cfg)
+    bb = {}
+    jd = jm = 0
+    for i in range(cfg.n_layers):
+        if pattern[i]:
+            k = jm
+            bb[f"layer_{i}"] = jax.tree.map(
+                lambda a, k=k: a[k], pparams["layers_moe"]
+            )
+            jm += 1
+        else:
+            k = jd
+            bb[f"layer_{i}"] = jax.tree.map(
+                lambda a, k=k: a[k], pparams["layers"]
+            )
+            jd += 1
     bb["tok_embed"] = {"embedding": pparams["tok_embed"]}
     bb["pos_embed"] = pparams["pos_embed"]
     bb["ln_final"] = {"scale": pparams["ln_scale"],
@@ -611,9 +747,13 @@ def train_distributed_pipeline(
     batch = DataBatch(x=jnp.asarray(x), y=jnp.asarray(y), w=jnp.asarray(w))
 
     tx = spec.make_optimizer()
+    # Build the step FIRST: its config validation (stage divisibility,
+    # MoE pattern uniformity, tp x MoE) produces actionable errors;
+    # placement would otherwise fail earlier with a raw sharding error.
+    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head)
     rng = jax.random.key(seed)
     flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
-    pparams = pipeline_params_from_flax(flax_params, cfg.n_layers)
+    pparams = pipeline_params_from_flax(flax_params, cfg)
     state = place_pipeline_state(pparams, tx, mesh)
 
     from sparktorch_tpu.train.sync import (
@@ -625,7 +765,6 @@ def train_distributed_pipeline(
     # PipelineState checkpoints like TrainState (step-indexed orbax
     # snapshots restored INTO the pp/tp-sharded layout).
     ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
-    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head)
 
     recorder = MetricsRecorder(n_chips=mesh.size)
     last_ckpt = int(jax.device_get(state.step)) if ckpt is not None else 0
@@ -640,6 +779,9 @@ def train_distributed_pipeline(
                 "examples": float(n), "grad_norm": float("nan"),
                 "step_time_s": time.perf_counter() - t0,
             }
+            drop = getattr(step, "last_drop_fraction", None)
+            if drop is not None:
+                record["moe_drop_fraction"] = drop
             recorder.record(record)
             if metrics_hook:
                 metrics_hook(record)
@@ -651,7 +793,7 @@ def train_distributed_pipeline(
         _finalize_checkpoint(ckpt, state, completed)
 
     trained = jax.device_get(state.params)
-    out_params = flax_params_from_pipeline(trained, cfg.n_layers)
+    out_params = flax_params_from_pipeline(trained, cfg)
     return TrainResult(params=out_params, model_state={},
                        metrics=recorder.records, spec=spec,
                        summary=recorder.summary())
