@@ -680,6 +680,8 @@ def train_distributed_pipeline(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
     resume: bool = False,
+    partition_shuffles: int = 1,
+    early_stop_patience: int = -1,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -766,28 +768,65 @@ def train_distributed_pipeline(
     # snapshots restored INTO the pp/tp-sharded layout).
     ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
 
+    from sparktorch_tpu.utils.early_stopper import EarlyStopping
+
+    stopper = (
+        EarlyStopping(patience=early_stop_patience)
+        if early_stop_patience is not None and early_stop_patience > 0
+        else None
+    )
     recorder = MetricsRecorder(n_chips=mesh.size)
     last_ckpt = int(jax.device_get(state.step)) if ckpt is not None else 0
     start = int(jax.device_get(state.step))
+    # Seed folded with the restored step: a resumed run must draw
+    # FRESH permutations, not replay the interrupted run's (same
+    # invariant as the streaming trainer's resume seeding).
+    shuffle_rng = np.random.default_rng(seed + 1 + start)
+    # On-device permutation: one small index upload per round instead
+    # of re-uploading the full x/y/w arrays from the host.
+    permute = jax.jit(
+        lambda b, p: DataBatch(x=b.x[p], y=b.y[p], w=b.w[p])
+    )
     completed = False
+    stop = False
     try:
-        for i in range(start, start + iters):
-            t0 = time.perf_counter()
-            state, loss = step(state, batch)
-            record = {
-                "round": 0, "iter": i, "loss": float(loss), "val_loss": None,
-                "examples": float(n), "grad_norm": float("nan"),
-                "step_time_s": time.perf_counter() - t0,
-            }
-            drop = getattr(step, "last_drop_fraction", None)
-            if drop is not None:
-                record["moe_drop_fraction"] = drop
-            recorder.record(record)
-            if metrics_hook:
-                metrics_hook(record)
-            if verbose:
-                print(f"[sparktorch_tpu:pp] iter {i} loss {float(loss):.6f}")
-            last_ckpt = _save_if_due(ckpt, state, last_ckpt, checkpoint_every)
+        for shuffle_round in range(max(1, partition_shuffles)):
+            if shuffle_round > 0:
+                # The reference's partition reshuffle between rounds
+                # (distributed.py:267-273): microbatch membership
+                # changes; weight-0 padding rows stay masked wherever
+                # they land.
+                batch = permute(
+                    batch, jnp.asarray(shuffle_rng.permutation(x.shape[0]))
+                )
+            for i in range(iters):
+                t0 = time.perf_counter()
+                state, loss = step(state, batch)
+                record = {
+                    "round": shuffle_round, "iter": i,
+                    "loss": float(loss), "val_loss": None,
+                    "examples": float(n), "grad_norm": float("nan"),
+                    "step_time_s": time.perf_counter() - t0,
+                }
+                drop = getattr(step, "last_drop_fraction", None)
+                if drop is not None:
+                    record["moe_drop_fraction"] = drop
+                recorder.record(record)
+                if metrics_hook:
+                    metrics_hook(record)
+                if verbose:
+                    print(f"[sparktorch_tpu:pp] round {shuffle_round} "
+                          f"iter {i} loss {float(loss):.6f}")
+                last_ckpt = _save_if_due(ckpt, state, last_ckpt,
+                                         checkpoint_every)
+                # The global loss is replicated on every host, so the
+                # per-host stopper reaches the identical decision (no
+                # extra collective — same argument as the DP trainer).
+                if stopper is not None and stopper.step(float(loss)):
+                    stop = True
+                    break
+            if stop:
+                break
         completed = True
     finally:
         _finalize_checkpoint(ckpt, state, completed)

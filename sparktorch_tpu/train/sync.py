@@ -174,10 +174,9 @@ def train_distributed(
         # the spec's CausalLM under the pipelined schedule and returns
         # ordinary flax params.
         unsupported = {
-            "early_stop_patience": early_stop_patience and early_stop_patience > 0,
-            "validation_pct": validation_pct and validation_pct > 0,
+            "validation_pct (pp early stop uses the train loss)":
+                validation_pct and validation_pct > 0,
             "mini_batch (n_micro microbatching covers it)": bool(mini_batch),
-            "partition_shuffles": partition_shuffles > 1,
             "steps_per_call": steps_per_call is not None,
             "profile_dir": bool(profile_dir),
             "pre_sharded": pre_sharded,
@@ -196,6 +195,8 @@ def train_distributed(
             n_micro=n_micro, verbose=verbose, seed=seed,
             metrics_hook=metrics_hook, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every, resume=resume,
+            partition_shuffles=partition_shuffles,
+            early_stop_patience=early_stop_patience,
         )
 
     if pre_sharded:

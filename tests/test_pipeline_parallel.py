@@ -327,6 +327,37 @@ def test_pipeline_classifier_head_exactness_and_estimator():
     assert set(np.unique(preds)) <= {0.0, 1.0}
 
 
+def test_pipeline_early_stop_and_shuffles():
+    """Early stopping (train-loss patience) and partition shuffles now
+    work under pp through train_distributed: lr=0 makes the loss
+    constant so the stopper fires after exactly patience+1 steps, and
+    shuffle rounds show up in the records."""
+    from sparktorch_tpu.models.transformer import CausalLM
+    from sparktorch_tpu.train.sync import train_distributed
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    cfg = _cfg(n_layers=2, vocab_size=32, max_len=8)
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32, (16, 9)).astype(np.int32)
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    spec0 = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                      optimizer="sgd", optimizer_params={"lr": 0.0})
+    r = train_distributed(spec0, x, labels=y, mesh=mesh, iters=32,
+                          early_stop_patience=2)
+    assert len(r.metrics) == 3, len(r.metrics)
+
+    spec1 = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                      optimizer="adam", optimizer_params={"lr": 1e-2})
+    r2 = train_distributed(spec1, x, labels=y, mesh=mesh, iters=3,
+                           partition_shuffles=2)
+    assert len(r2.metrics) == 6
+    assert {m["round"] for m in r2.metrics} == {0, 1}
+    losses = [m["loss"] for m in r2.metrics]
+    assert losses[-1] < losses[0], losses
+
+
 def test_pipeline_checkpoint_resume_via_train_distributed(tmp_path):
     """checkpoint_dir/resume work under a pp>1 mesh through the
     ordinary train_distributed surface: a run killed after N steps
@@ -352,8 +383,9 @@ def test_pipeline_checkpoint_resume_via_train_distributed(tmp_path):
     resumed = train_distributed(spec(), x, labels=y, mesh=mesh, iters=3,
                                 seed=0, checkpoint_dir=d,
                                 checkpoint_every=1, resume=True)
-    # Resumed run continues at iter 3 and lands on the same losses.
-    assert resumed.metrics[0]["iter"] == 3
+    # Record numbering restarts per run (DP-trainer convention); the
+    # training STATE continues: losses match the uninterrupted tail.
+    assert resumed.metrics[0]["iter"] == 0
     full_tail = [m["loss"] for m in full.metrics[3:]]
     res_losses = [m["loss"] for m in resumed.metrics]
     np.testing.assert_allclose(res_losses, full_tail, rtol=1e-5)
