@@ -442,7 +442,10 @@ def make_pp_train_step(
 
     ring = [(i, (i + 1) % S) for i in range(S)]
 
-    def local_step(params, opt_state, x, y, w):
+    def schedule_loss(params, x, y, w):
+        """The full GPipe schedule's global weighted-mean loss (plus
+        the MoE aux term and drop fraction) — differentiated by
+        local_step, called forward-only by the eval step."""
         stage = jax.lax.axis_index(AXIS_PP)
         b_local, s = x.shape
         if b_local % n_micro != 0:
@@ -511,7 +514,8 @@ def make_pp_train_step(
             )
             num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
             den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
-            loss = num_g / jnp.maximum(den_g, 1.0)
+            task = num_g / jnp.maximum(den_g, 1.0)
+            loss = task
             if has_moe:
                 # Sum over stages/layers (psum pp — stages hold
                 # disjoint MoE layers), mean over microbatches and dp
@@ -525,10 +529,16 @@ def make_pp_train_step(
                 drop_fraction = dropped_g / jnp.maximum(routed_g, 1.0)
             else:
                 drop_fraction = jnp.zeros(())
-            return loss, drop_fraction
+            # aux pair: (drop_fraction, task-only loss) — the eval
+            # path reports the task loss (the DP eval excludes sown
+            # aux objectives from the validation signal too).
+            return loss, (drop_fraction, task)
 
-        (loss, drop_fraction), grads = jax.value_and_grad(
-            pipeline_loss, has_aux=True
+        return pipeline_loss(params)
+
+    def local_step(params, opt_state, x, y, w):
+        (loss, (drop_fraction, _)), grads = jax.value_and_grad(
+            lambda p: schedule_loss(p, x, y, w), has_aux=True
         )(params)
         # Replicated-param grads must be summed over every axis the
         # param is replicated across: layer stacks live on one pp
@@ -553,6 +563,19 @@ def make_pp_train_step(
 
     cache = {}
 
+    def _build_eval(specs):
+        """Forward-only schedule for validation: same pipeline, no
+        grads, reporting the TASK loss (the [1][1] aux slot — sown MoE
+        aux objectives are excluded from the validation signal, like
+        the DP eval)."""
+        eval_mapped = shard_map_compat(
+            lambda p, x, y, w: schedule_loss(p, x, y, w)[1][1],
+            mesh,
+            in_specs=(specs, P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
+            out_specs=P(),
+        )
+        return jax.jit(eval_mapped)
+
     def step(state: PipelineState, batch: DataBatch):
         if "jitted" not in cache:
             specs = _param_specs(state.params)
@@ -565,6 +588,7 @@ def make_pp_train_step(
                 out_specs=(specs, opt_specs, P(), P()),
             )
             cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
+            cache["eval"] = _build_eval(specs)
         new_params, new_opt, loss, drop = cache["jitted"](
             state.params, state.opt_state, batch.x, batch.y, batch.w
         )
@@ -578,6 +602,12 @@ def make_pp_train_step(
             loss,
         )
 
+    def eval_loss(state: PipelineState, batch: DataBatch):
+        if "eval" not in cache:
+            cache["eval"] = _build_eval(_param_specs(state.params))
+        return cache["eval"](state.params, batch.x, batch.y, batch.w)
+
+    step.eval_loss = eval_loss
     return step
 
 
@@ -682,6 +712,7 @@ def train_distributed_pipeline(
     resume: bool = False,
     partition_shuffles: int = 1,
     early_stop_patience: int = -1,
+    validation_pct: float = 0.0,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -738,15 +769,33 @@ def train_distributed_pipeline(
     x = x.astype(np.int32)
     y = y.astype(np.int32)
 
+    from sparktorch_tpu.utils.data import pad_to_multiple
+
     dp = mesh.shape[AXIS_DP]
     need = dp * n_micro
+
+    def _pad_batch(bx, by, bw):
+        return pad_to_multiple(
+            DataBatch(x=jnp.asarray(bx), y=jnp.asarray(by),
+                      w=jnp.asarray(bw)),
+            need,
+        )
+
+    val_batch = None
+    if validation_pct and validation_pct > 0:
+        # Split BEFORE padding (the reference's per-worker holdout,
+        # util.py:81-95): a shuffled cut of real rows, keeping any
+        # caller-supplied sample weights.
+        perm0 = np.random.default_rng(seed).permutation(x.shape[0])
+        n_val = max(1, int(x.shape[0] * validation_pct))
+        val_idx, train_idx = perm0[:n_val], perm0[n_val:]
+        if train_idx.size == 0:
+            raise ValueError("validation_pct leaves no training rows")
+        val_batch = _pad_batch(x[val_idx], y[val_idx], w[val_idx])
+        x, y, w = x[train_idx], y[train_idx], w[train_idx]
     n = int(np.sum(w > 0))
-    pad = (-x.shape[0]) % need
-    if pad:
-        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
-        w = np.concatenate([w, np.zeros((pad,), np.float32)])
-    batch = DataBatch(x=jnp.asarray(x), y=jnp.asarray(y), w=jnp.asarray(w))
+    batch = _pad_batch(x, y, w)
+    n_rows_padded = int(batch.x.shape[0])
 
     tx = spec.make_optimizer()
     # Build the step FIRST: its config validation (stage divisibility,
@@ -797,14 +846,19 @@ def train_distributed_pipeline(
                 # changes; weight-0 padding rows stay masked wherever
                 # they land.
                 batch = permute(
-                    batch, jnp.asarray(shuffle_rng.permutation(x.shape[0]))
+                    batch,
+                    jnp.asarray(shuffle_rng.permutation(n_rows_padded)),
                 )
             for i in range(iters):
                 t0 = time.perf_counter()
                 state, loss = step(state, batch)
+                val_loss = (
+                    float(step.eval_loss(state, val_batch))
+                    if val_batch is not None else None
+                )
                 record = {
                     "round": shuffle_round, "iter": i,
-                    "loss": float(loss), "val_loss": None,
+                    "loss": float(loss), "val_loss": val_loss,
                     "examples": float(n), "grad_norm": float("nan"),
                     "step_time_s": time.perf_counter() - t0,
                 }
@@ -815,14 +869,19 @@ def train_distributed_pipeline(
                 if metrics_hook:
                     metrics_hook(record)
                 if verbose:
-                    print(f"[sparktorch_tpu:pp] round {shuffle_round} "
-                          f"iter {i} loss {float(loss):.6f}")
+                    msg = (f"[sparktorch_tpu:pp] round {shuffle_round} "
+                           f"iter {i} loss {float(loss):.6f}")
+                    if val_loss is not None:
+                        msg += f" val_loss {val_loss:.6f}"
+                    print(msg)
                 last_ckpt = _save_if_due(ckpt, state, last_ckpt,
                                          checkpoint_every)
                 # The global loss is replicated on every host, so the
                 # per-host stopper reaches the identical decision (no
                 # extra collective — same argument as the DP trainer).
-                if stopper is not None and stopper.step(float(loss)):
+                if stopper is not None and stopper.step(
+                    val_loss if val_loss is not None else float(loss)
+                ):
                     stop = True
                     break
             if stop:

@@ -174,8 +174,6 @@ def train_distributed(
         # the spec's CausalLM under the pipelined schedule and returns
         # ordinary flax params.
         unsupported = {
-            "validation_pct (pp early stop uses the train loss)":
-                validation_pct and validation_pct > 0,
             "mini_batch (n_micro microbatching covers it)": bool(mini_batch),
             "steps_per_call": steps_per_call is not None,
             "profile_dir": bool(profile_dir),
@@ -197,6 +195,7 @@ def train_distributed(
             checkpoint_every=checkpoint_every, resume=resume,
             partition_shuffles=partition_shuffles,
             early_stop_patience=early_stop_patience,
+            validation_pct=validation_pct,
         )
 
     if pre_sharded:

@@ -358,6 +358,29 @@ def test_pipeline_early_stop_and_shuffles():
     assert losses[-1] < losses[0], losses
 
 
+def test_pipeline_validation_split_and_early_stop():
+    """validation_pct now works under pp: a holdout is cut before
+    padding, the forward-only pipelined eval reports val_loss per
+    step, and early stopping keys on it."""
+    from sparktorch_tpu.models.transformer import CausalLM
+    from sparktorch_tpu.train.sync import train_distributed
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    cfg = _cfg(n_layers=2, vocab_size=32, max_len=8)
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32, (32, 9)).astype(np.int32)
+    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 5e-2})
+    r = train_distributed(spec, ids[:, :-1], labels=ids[:, 1:], mesh=mesh,
+                          iters=100, validation_pct=0.25,
+                          early_stop_patience=3)
+    assert all(m["val_loss"] is not None for m in r.metrics)
+    assert len(r.metrics) < 100, len(r.metrics)
+    # Training examples exclude the holdout.
+    assert r.metrics[0]["examples"] == 24.0
+
+
 def test_pipeline_checkpoint_resume_via_train_distributed(tmp_path):
     """checkpoint_dir/resume work under a pp>1 mesh through the
     ordinary train_distributed surface: a run killed after N steps
