@@ -163,6 +163,43 @@ class BatchPredictor:
         host.append(np.asarray(prev[0])[: prev[1]])
         return np.concatenate(host) if len(host) > 1 else host[0]
 
+    def predict_device(self, x, in_flight: int = 3):
+        """Chunked forward with ZERO device->host readbacks: returns
+        ONE device array of predictions (padding trimmed), leaving the
+        download to the caller.
+
+        Why this exists: on tunnel-attached chips the host->device
+        upload fast-path degrades by ~50x after the FIRST device->host
+        readback of any size — even a scalar (measured on this rig:
+        1.4 GB/s before, ~6-25 MB/s after; see ROUND4_NOTES). The
+        ordinary ``predict`` interleaves a readback per chunk, so a
+        long upload-streaming run (BASELINE config 5) gets wire-bound
+        at ~50 rows/s. This path keeps every chunk's output on device
+        — pacing the pipeline with ``block_until_ready`` (a sync, not
+        a transfer, which does NOT trigger the degradation) so at most
+        ``in_flight`` chunks of input occupy HBM — and the caller
+        downloads results once, after the stream, when upload speed no
+        longer matters."""
+        n = x.shape[0]
+        if n == 0:
+            # Shape probe WITHOUT the readback predict() does — one
+            # readback is exactly what this method exists to avoid.
+            probe = np.zeros((self._n_shards, *x.shape[1:]), x.dtype)
+            out = self._fwd(self._params, self._model_state,
+                            self._put(probe))
+            return out[:0]
+        outs = []
+        pending = []
+        for part, real in self._chunks(x, n):
+            dev = self._put(part)
+            out = self._fwd(self._params, self._model_state, dev)
+            outs.append(out[:real] if real != out.shape[0] else out)
+            pending.append(out)
+            if len(pending) >= max(2, in_flight):
+                # Transfer-free backpressure: bound live input buffers.
+                pending.pop(0).block_until_ready()
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
     def predict_stream(self, batches: Iterable[np.ndarray]) -> Iterator[np.ndarray]:
         """Partition-parallel streaming inference: feed numpy batches
         (e.g. parquet row groups), get predictions per batch — the
@@ -216,6 +253,9 @@ def stream_parquet_predict(
     batch_rows: Optional[int] = None,
     drain=None,
     prefetch: int = 2,
+    skip_rows: int = 0,
+    max_rows: Optional[int] = None,
+    device_outputs: bool = False,
 ) -> dict:
     """Columnar-ingest -> device streaming inference: the measured
     BASELINE config-5 path (the reference feeds DataFrame partitions
@@ -235,6 +275,18 @@ def stream_parquet_predict(
     (e.g. to write results out); defaults to discarding after a shape
     check. Returns timing stats incl. per-stage busy times so overlap
     is visible: wall << read_busy + predict_busy when pipelined.
+
+    ``skip_rows``/``max_rows`` window the stream (resume support for
+    long runs): the reader drops the first ``skip_rows`` rows (sliced
+    at record-batch granularity) and ends after ``max_rows`` rows.
+
+    ``device_outputs=True`` routes through ``predict_device``: drain
+    receives DEVICE arrays and no device->host readback happens inside
+    the stream — required for sustained rates on tunnel-attached chips
+    whose upload fast-path degrades after the first readback (see
+    ``predict_device``). ``predict_busy`` then measures dispatch, not
+    completion; the wall time stays honest (the caller's final
+    download syncs everything).
     """
     import queue as _queue
     import threading
@@ -262,18 +314,38 @@ def stream_parquet_predict(
     def reader():
         try:
             pf = pq.ParquetFile(path)
-            for rb in pf.iter_batches(
+            it = iter(pf.iter_batches(
                 batch_size=batch_rows or predictor.chunk, columns=[column]
-            ):
-                if stop.is_set():
-                    return
+            ))
+            to_skip = max(0, int(skip_rows))
+            budget = max_rows if max_rows is not None else float("inf")
+            while budget > 0:
+                # Time the iterator pull itself: the Parquet disk IO +
+                # Arrow decode happen inside __next__, and they are the
+                # bulk of read_busy — timing only the numpy reshape
+                # (as before) made a 14 GB read look like 0.014 s and
+                # voided the overlap_factor claim.
                 t0 = _time.perf_counter()
+                rb = next(it, None)
+                if rb is None or stop.is_set():
+                    read_busy[0] += _time.perf_counter() - t0
+                    return
                 col = rb.column(0)
+                if to_skip >= len(col):
+                    to_skip -= len(col)
+                    read_busy[0] += _time.perf_counter() - t0
+                    continue
                 buf = col.buffers()[-1]
                 arr = np.frombuffer(
                     buf, dtype=dtype, count=len(col) * row_elems,
                     offset=col.offset * row_elems * itemsize,
                 ).reshape(len(col), *row_shape)
+                if to_skip:
+                    arr = arr[to_skip:]
+                    to_skip = 0
+                if arr.shape[0] > budget:
+                    arr = arr[: int(budget)]
+                budget -= arr.shape[0]
                 read_busy[0] += _time.perf_counter() - t0
                 if not _put(arr):
                     return
@@ -320,7 +392,8 @@ def stream_parquet_predict(
             if item is None:
                 break
             t0 = _time.perf_counter()
-            out = predictor.predict(item)
+            out = (predictor.predict_device(item) if device_outputs
+                   else predictor.predict(item))
             predict_busy += _time.perf_counter() - t0
             assert out.shape[0] == item.shape[0]
             if drain is not None:

@@ -51,7 +51,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparktorch_tpu.models.transformer import EncoderLayer, TransformerConfig
 from sparktorch_tpu.ops.attention import dense_attention
-from sparktorch_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP
+from sparktorch_tpu.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 from sparktorch_tpu.train.step import shard_map_compat
 from sparktorch_tpu.utils.data import DataBatch
 
@@ -60,6 +60,16 @@ class PipelineState(NamedTuple):
     step: jax.Array
     params: Any
     opt_state: Any
+
+
+class PpStepOut(NamedTuple):
+    """Per-step arrays from a fused multi-schedule call
+    (``steps_per_call > 1``), each shaped ``(k,)``."""
+
+    loss: jax.Array
+    drop_fraction: Optional[jax.Array]
+    grad_norm: jax.Array
+    examples: jax.Array
 
 
 # ---------------------------------------------------------------------------
@@ -103,6 +113,49 @@ def _tp_reduce_bwd(_, ct):
 
 
 _tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+@jax.custom_vjp
+def _ep_enter(x):
+    """Entry of the expert-parallel path: identity forward, psum-over-
+    ep backward. Each ep member's expert-path input-cotangent covers
+    only ITS experts' share; summing them here makes the cotangent
+    leaving the MoE FFN complete and ep-identical, so every upstream
+    gradient (attn, ln, dense layers, embeddings) keeps the ordinary
+    replicated-over-ep reductions."""
+    return x
+
+
+def _ep_enter_fwd(x):
+    return x, None
+
+
+def _ep_enter_bwd(_, ct):
+    return (jax.lax.psum(ct, AXIS_EP),)
+
+
+_ep_enter.defvjp(_ep_enter_fwd, _ep_enter_bwd)
+
+
+@jax.custom_vjp
+def _ep_reduce(x):
+    """Exit of the expert-parallel path: psum forward (combine the
+    per-member partial expert outputs), identity backward (each member
+    receives the full output cotangent ONCE — a raw psum would
+    transpose to another psum and double-count it; same trap the tp
+    f/g pair guards)."""
+    return jax.lax.psum(x, AXIS_EP)
+
+
+def _ep_reduce_fwd(x):
+    return jax.lax.psum(x, AXIS_EP), None
+
+
+def _ep_reduce_bwd(_, ct):
+    return (ct,)
+
+
+_ep_reduce.defvjp(_ep_reduce_fwd, _ep_reduce_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -161,6 +214,139 @@ def _moe_pattern(cfg: TransformerConfig):
     """Per-layer use_moe flags — delegates to the ONE schedule
     definition on the config (shared with the flax Transformer)."""
     return cfg.moe_pattern()
+
+
+class _AttnPart(nn.Module):
+    """The pre-FFN half of ``EncoderLayer`` (ln_attn -> attn residual
+    -> ln_mlp) as a standalone module with the SAME submodule names,
+    so it applies the same stacked param subtree — used by the ep>1
+    MoE path, which splits the layer so the expert FFN can run under
+    manual expert parallelism."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from sparktorch_tpu.models.transformer import MultiHeadAttention
+
+        cfg = self.config
+        dt = cfg.compute_dtype
+        h = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=dt, name="ln_mlp")(x)
+        return x, h
+
+
+def _moe_ffn_ep(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
+    """Expert-parallel MoE FFN inside the pp shard_map: the exact math
+    of :class:`models.transformer.MoEFFN` in explicit form, with the
+    expert dimension SHARDED over the ``ep`` mesh axis.
+
+    Layout: tokens are replicated across ep members (the batch shards
+    over dp only), the router is replicated so every member computes
+    identical routing, and each member applies only its local slice of
+    experts — one psum over ``ep`` combines the partial outputs. No
+    all-to-all is needed in this layout: what GSPMD derives from
+    operand shardings in the sharded trainer becomes a single combine
+    reduction here. Returns (out, aux_loss, dropped, routed) — the
+    same observables MoEFFN sows.
+
+    ``mp`` is the LOCAL moe param subtree: expert leaves arrive
+    pre-sliced to ``e_loc = n_experts/ep`` by shard_map; router params
+    replicated."""
+    import math
+
+    dt = cfg.compute_dtype
+    b, s, d = h.shape
+    e = cfg.n_experts
+    e_loc = e // n_ep
+    k = max(1, min(cfg.moe_top_k, e))
+    n = b * s
+    g = min(n, max(1, cfg.moe_group_size))
+    while n % g:
+        g -= 1
+    n_groups = n // g
+    tokens = h.reshape(n_groups, g, d)
+    if n_ep > 1:
+        # Identity forward / psum-over-ep backward: the ONLY consumer
+        # of `tokens` is the expert path (router + dispatch), whose
+        # per-member input-cotangents are partial (one expert slice
+        # each) — _ep_enter completes them so upstream grads stay
+        # ep-replicated.
+        tokens = _ep_enter(tokens)
+    cap = max(1, math.ceil(cfg.capacity_factor * g * k / e))
+    mask = (token_w.reshape(n_groups, g) > 0) if token_w is not None else None
+
+    # Router in f32, replicated across ep: identical routing everywhere.
+    logits = (
+        tokens.astype(jnp.float32) @ mp["router"]["kernel"]
+        + mp["router"]["bias"]
+    )                                            # (G, g, e)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topk_p, topk_idx = jax.lax.top_k(probs, k)   # (G, g, k)
+    if k == 1:
+        gates = topk_p
+    else:
+        gates = topk_p / jnp.maximum(
+            jnp.sum(topk_p, axis=-1, keepdims=True), 1e-9
+        )
+    oh = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # (G, g, k, e)
+    if mask is not None:
+        oh = oh * mask[:, :, None, None]
+        gates = gates * mask[:, :, None]
+    # Choice-major capacity priority (GShard), as in MoEFFN.
+    oh_t = oh.transpose(0, 2, 1, 3).reshape(n_groups, k * g, e)
+    pos = jnp.cumsum(oh_t, axis=1) * oh_t
+    keep = (pos > 0) & (pos <= cap)
+    slot = jnp.clip(pos - 1, 0, cap - 1)
+    disp_flat = keep[..., None] & jax.nn.one_hot(slot, cap, dtype=bool)
+    disp = disp_flat.reshape(n_groups, k, g, e, cap).transpose(0, 2, 1, 3, 4)
+
+    dispatch = jnp.any(disp, axis=2).astype(dt)  # (G, g, e, cap)
+    combine = jnp.einsum("gnk,gnkec->gnec", gates.astype(dt),
+                         disp.astype(dt))        # (G, g, e, cap)
+    # Local experts slice of the (replicated) dispatch/combine plans.
+    if n_ep > 1:
+        off = jax.lax.axis_index(AXIS_EP) * e_loc
+        dispatch = jax.lax.dynamic_slice_in_dim(dispatch, off, e_loc, axis=2)
+        combine = jax.lax.dynamic_slice_in_dim(combine, off, e_loc, axis=2)
+
+    expert_in = jnp.einsum("gnec,gnd->gecd", dispatch, tokens.astype(dt))
+    hmid = jnp.einsum("gecd,edf->gecf", expert_in, mp["moe_w_in"].astype(dt))
+    hmid = nn.gelu(hmid + mp["moe_b_in"][None, :, None].astype(dt))
+    expert_out = jnp.einsum("gecf,efd->gecd", hmid,
+                            mp["moe_w_out"].astype(dt))
+    expert_out = expert_out + mp["moe_b_out"][None, :, None].astype(dt)
+    out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
+    if n_ep > 1:
+        # Each member combined only its experts' outputs; the sum over
+        # ep members is the full gate-weighted combine (custom-vjp:
+        # identity backward, so the output cotangent isn't re-summed).
+        out = _ep_reduce(out)
+
+    # Switch load-balance aux + drop counts over valid tokens, exactly
+    # as MoEFFN sows them (replicated across ep — computed from the
+    # replicated routing, so no reduction needed).
+    oh0 = oh[:, :, 0, :].astype(jnp.float32)
+    if mask is not None:
+        mf = mask.astype(jnp.float32)
+        valid = jnp.maximum(jnp.sum(mf, axis=1), 1.0)
+        frac = jnp.sum(oh0, axis=1) / valid[:, None]
+        mean_prob = jnp.sum(probs * mf[:, :, None], axis=1) / valid[:, None]
+    else:
+        frac = jnp.mean(oh0, axis=1)
+        mean_prob = jnp.mean(probs, axis=1)
+    aux = cfg.moe_aux_weight * e * jnp.mean(jnp.sum(frac * mean_prob, -1))
+    if n_ep > 1:
+        # The aux VALUE is replicated across ep (computed from the
+        # replicated routing), but its router gradient is computed in
+        # full on every member — while the task path contributes only
+        # a per-member share. Scale the aux GRADIENT by 1/ep (value
+        # unchanged) so the (dp, ep) psum of router grads is exact.
+        aux = aux / n_ep + jax.lax.stop_gradient(aux * (1.0 - 1.0 / n_ep))
+    routed = jnp.sum(oh).astype(jnp.float32)
+    kept = jnp.sum(keep.astype(jnp.float32))
+    return out.reshape(b, s, d), aux, routed - kept, routed
 
 
 def _stacked_layer_init(cfg, key, use_moe: bool, n: int):
@@ -246,24 +432,48 @@ def _layer_leaf_spec(path_names: Tuple[str, ...], ndim: int) -> P:
     return P(AXIS_PP)
 
 
+_MOE_EXPERT_LEAVES = ("moe_w_in", "moe_b_in", "moe_w_out", "moe_b_out")
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    """Decode a tree_util key path into plain name strings — the one
+    place for the idiom, so the grad-reduction and norm-weighting
+    rules keyed off these names stay consistent with the sharding
+    specs."""
+    return tuple(
+        str(getattr(p, "key", getattr(p, "name", p))) for p in path
+    )
+
+
+def _moe_leaf_spec(path_names: Tuple[str, ...]) -> P:
+    """Spec for one stacked MoE-layer leaf: pp on the stack dim, and —
+    for the expert weight tensors, whose dim 1 is the experts dim —
+    ep, so experts shard ACROSS chips within a pipeline stage. The
+    router/ln/attn params replicate over ep (every ep member routes
+    identically)."""
+    if path_names[-1] in _MOE_EXPERT_LEAVES:
+        return P(AXIS_PP, AXIS_EP)
+    return P(AXIS_PP)
+
+
 def _param_specs(params) -> Any:
     """Per-leaf PartitionSpecs: layer stacks split over pp on their
     leading (layer) dim and over tp on head/column dims; MoE layer
-    stacks split over pp only (experts replicated within a stage — tp
-    is rejected with MoE); everything else replicated."""
+    stacks split over pp (stack dim) and ep (experts dim of the expert
+    weights — tp is rejected with MoE); everything else replicated."""
     from jax.tree_util import tree_map_with_path
 
     def layers_spec(path, leaf):
-        names = tuple(
-            str(getattr(p, "key", getattr(p, "name", p))) for p in path
-        )
-        return _layer_leaf_spec(names, np.ndim(leaf))
+        return _layer_leaf_spec(_path_names(path), np.ndim(leaf))
+
+    def moe_spec(path, leaf):
+        return _moe_leaf_spec(_path_names(path))
 
     return {
         k: (
             tree_map_with_path(layers_spec, v)
             if k == "layers"
-            else jax.tree.map(lambda _: P(AXIS_PP), v)
+            else tree_map_with_path(moe_spec, v)
             if k == "layers_moe"
             else jax.tree.map(lambda _: P(), v)
         )
@@ -304,22 +514,42 @@ def make_pp_train_step(
     mesh: Mesh,
     n_micro: int,
     head: str = "lm",
+    mini_batch: Optional[int] = None,
+    steps_per_call: int = 1,
 ) -> Callable[[PipelineState, DataBatch], Tuple[PipelineState, jax.Array]]:
     """Build the jitted pipelined train step over ``mesh`` (dp x pp x
     tp; other axes must be 1 for this trainer).
 
     ``head``: ``'lm'`` (next-token CE over the vocab, causal) or
     ``'classifier'`` (BERT-style pooler + class CE — the config-4
-    workload, pipelined)."""
+    workload, pipelined).
+
+    ``mini_batch`` (per dp shard, like the DP trainer's): each step
+    samples a contiguous random block of that many rows ON DEVICE
+    (``utils.data.sample_minibatch``) and feeds it to the microbatch
+    split — so it must divide into ``n_micro`` microbatches.
+    ``steps_per_call=k`` scans k WHOLE schedules inside the one jitted
+    call (fresh minibatch sample per step); with ``k == 1`` the step
+    returns a scalar loss as before, otherwise ``(state, PpStepOut)``
+    with per-step arrays."""
     if head not in ("lm", "classifier"):
         raise ValueError(f"unknown head {head!r}")
-    for ax in mesh.shape:
-        if ax not in (AXIS_DP, AXIS_PP, AXIS_TP) and mesh.shape[ax] != 1:
+    K = max(1, int(steps_per_call))
+    if mini_batch is not None and mini_batch > 0:
+        if mini_batch % n_micro != 0:
             raise ValueError(
-                f"pipeline trainer supports dp x pp x tp only; {ax}>1"
+                f"mini_batch={mini_batch} not divisible by "
+                f"n_micro={n_micro}"
+            )
+    for ax in mesh.shape:
+        if (ax not in (AXIS_DP, AXIS_PP, AXIS_TP, AXIS_EP)
+                and mesh.shape[ax] != 1):
+            raise ValueError(
+                f"pipeline trainer supports dp x pp x tp x ep only; {ax}>1"
             )
     S = mesh.shape[AXIS_PP]
     T = mesh.shape[AXIS_TP]
+    E = dict(mesh.shape).get(AXIS_EP, 1)
     if cfg.n_layers % max(1, S) != 0:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={S}")
     if cfg.n_heads % max(1, T) != 0:
@@ -332,12 +562,20 @@ def make_pp_train_step(
     # stays the GSPMD trainer's ep axis.
     pattern = _moe_pattern(cfg)
     has_moe = any(pattern)
+    if E > 1 and not has_moe:
+        raise ValueError(
+            "mesh ep>1 needs MoE layers (n_experts>0) — there are no "
+            "experts to shard"
+        )
     if has_moe:
         if T > 1:
             raise ValueError(
-                "pp x tp with MoE layers is not supported (experts "
-                "replicate within a stage); use tp=1, or the GSPMD "
-                "sharded trainer with the ep axis for expert parallelism"
+                "pp x tp with MoE layers is not supported; use tp=1 "
+                "(experts shard over the ep axis instead)"
+            )
+        if E > 1 and cfg.n_experts % E != 0:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} not divisible by ep={E}"
             )
         lps = cfg.n_layers // max(1, S)
         stage_patterns = [pattern[s * lps:(s + 1) * lps] for s in range(S)]
@@ -374,8 +612,22 @@ def make_pp_train_step(
         from sparktorch_tpu.train.step import _moe_drop_counts
 
         moe_layer = EncoderLayer(cfg, use_moe=True)
+        attn_part = _AttnPart(cfg)
 
         def moe_apply(lp, h, token_w):
+            if E > 1:
+                # ep>1: split the layer so the expert FFN runs under
+                # manual expert parallelism (experts pre-sliced over
+                # the ep axis by shard_map; one psum combines).
+                x_mid, h_ln = attn_part.apply(
+                    {"params": {k: lp[k]
+                                for k in ("ln_attn", "attn", "ln_mlp")}},
+                    h,
+                )
+                moe_out, aux, dropped, routed = _moe_ffn_ep(
+                    cfg, lp["moe"], h_ln, token_w, E
+                )
+                return x_mid + moe_out, aux, dropped, routed
             out, sown = moe_layer.apply(
                 {"params": lp}, h, token_w,
                 mutable=["losses", "moe_metrics"],
@@ -516,6 +768,7 @@ def make_pp_train_step(
             den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
             task = num_g / jnp.maximum(den_g, 1.0)
             loss = task
+            examples = den_g
             if has_moe:
                 # Sum over stages/layers (psum pp — stages hold
                 # disjoint MoE layers), mean over microbatches and dp
@@ -529,37 +782,128 @@ def make_pp_train_step(
                 drop_fraction = dropped_g / jnp.maximum(routed_g, 1.0)
             else:
                 drop_fraction = jnp.zeros(())
-            # aux pair: (drop_fraction, task-only loss) — the eval
-            # path reports the task loss (the DP eval excludes sown
-            # aux objectives from the validation signal too).
-            return loss, (drop_fraction, task)
+            # aux triple: (drop_fraction, task-only loss, examples) —
+            # the eval path reports the task loss (the DP eval
+            # excludes sown aux objectives from the validation signal
+            # too); examples is the global weighted row count actually
+            # trained on this step (== mini_batch rows when sampling).
+            return loss, (drop_fraction, task, examples)
 
         return pipeline_loss(params)
 
-    def local_step(params, opt_state, x, y, w):
-        (loss, (drop_fraction, _)), grads = jax.value_and_grad(
-            lambda p: schedule_loss(p, x, y, w), has_aux=True
-        )(params)
-        # Replicated-param grads must be summed over every axis the
-        # param is replicated across: layer stacks live on one pp
-        # shard each (sum over dp only); embed/head/norm are used on
-        # all stages (masked elsewhere -> zero grads) and replicated
-        # over both axes. No tp reductions anywhere: the f/g pair in
-        # _layer_forward already makes every grad complete and
-        # tp-identical.
-        grads = {
-            k: (
-                jax.tree.map(lambda g: jax.lax.psum(g, AXIS_DP), v)
-                if k in ("layers", "layers_moe")
-                else jax.tree.map(
-                    lambda g: jax.lax.psum(g, (AXIS_PP, AXIS_DP)), v
+    def local_step(params, opt_state, x, y, w, key):
+        dp_idx = jax.lax.axis_index(AXIS_DP)
+
+        def one(carry, sub):
+            params, opt_state = carry
+            if mini_batch is not None and 0 < mini_batch < x.shape[0]:
+                from sparktorch_tpu.utils.data import sample_minibatch
+
+                # Fold in the dp index: each dp shard samples its own
+                # block, but pp/tp members of the same dp row MUST
+                # sample the same rows (they cooperate on one batch).
+                b = sample_minibatch(
+                    DataBatch(x=x, y=y, w=w),
+                    jax.random.fold_in(sub, dp_idx), mini_batch,
                 )
+            else:
+                b = DataBatch(x=x, y=y, w=w)
+            (loss, (drop_fraction, _, examples)), grads = jax.value_and_grad(
+                lambda p: schedule_loss(p, b.x, b.y, b.w), has_aux=True
+            )(params)
+            # Replicated-param grads must be summed over every axis
+            # the param is replicated across: layer stacks live on one
+            # pp shard each (sum over dp only); embed/head/norm are
+            # used on all stages (masked elsewhere -> zero grads) and
+            # replicated over both axes. No tp reductions anywhere:
+            # the f/g pair in _layer_forward already makes every grad
+            # complete and tp-identical. With ep>1, _ep_enter keeps
+            # every grad ep-replicated EXCEPT the router's, whose
+            # per-member share must additionally sum over ep (expert
+            # leaves are ep-SHARDED and need no ep reduction).
+            def _reduce_moe(path, g):
+                names = _path_names(path)
+                if E > 1 and "router" in names:
+                    return jax.lax.psum(g, (AXIS_DP, AXIS_EP))
+                return jax.lax.psum(g, AXIS_DP)
+
+            from jax.tree_util import tree_map_with_path
+
+            grads = {
+                k: (
+                    jax.tree.map(lambda g: jax.lax.psum(g, AXIS_DP), v)
+                    if k == "layers"
+                    else tree_map_with_path(_reduce_moe, v)
+                    if k == "layers_moe"
+                    else jax.tree.map(
+                        lambda g: jax.lax.psum(g, (AXIS_PP, AXIS_DP)), v
+                    )
+                )
+                for k, v in grads.items()
+            }
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # Post-reduction grads are complete on every shard for the
+            # params that shard owns: expert leaves are distinct per
+            # (pp, ep) shard; other layer-stack squares distinct per
+            # pp stage (dp/tp/ep-identical); embed/head/norm identical
+            # everywhere. One FULL-mesh psum (the same collective
+            # family the loss uses) with static 1/extent weights
+            # counts each square exactly once in the global norm.
+            S_pp = mesh.shape[AXIS_PP]
+            S_dp = mesh.shape[AXIS_DP]
+            E_ax = E if E > 1 else 1
+            T_ax = T if T > 1 else 1
+            norm_axes = (
+                (AXIS_PP, AXIS_DP)
+                + ((AXIS_EP,) if E > 1 else ())
+                + ((AXIS_TP,) if T > 1 else ())
             )
-            for k, v in grads.items()
-        }
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt, loss, drop_fraction
+
+            def _sq_moe(path, g):
+                names = _path_names(path)
+                # Expert leaves are distinct per (pp, ep) shard; the
+                # rest of the MoE layer is ep-replicated. (tp is
+                # rejected with MoE, so no tp term here.)
+                w_ = (1.0 / S_dp if names[-1] in _MOE_EXPERT_LEAVES
+                      else 1.0 / (S_dp * E_ax))
+                return jnp.sum(jnp.square(g)) * w_
+
+            def _sq_layers(path, g):
+                names = _path_names(path)
+                # qkv/proj/mlp leaves are tp-SHARDED (distinct per
+                # (pp, tp) shard); ln and output-side biases are
+                # tp-replicated. Dense stacks are ep-replicated.
+                is_tp_sharded = any(
+                    names[-len(key):] == key for key in _TP_LAYER_DIMS
+                )
+                w_ = (1.0 / (S_dp * E_ax) if is_tp_sharded
+                      else 1.0 / (S_dp * E_ax * T_ax))
+                return jnp.sum(jnp.square(g)) * w_
+
+            sq = {
+                k: (
+                    sum(jax.tree.leaves(tree_map_with_path(_sq_moe, v)))
+                    if k == "layers_moe"
+                    else sum(jax.tree.leaves(
+                        tree_map_with_path(_sq_layers, v)))
+                    if k == "layers"
+                    else sum(jnp.sum(jnp.square(g))
+                             for g in jax.tree.leaves(v))
+                    * (1.0 / (S_dp * S_pp * E_ax * T_ax))
+                )
+                for k, v in grads.items()
+            }
+            grad_norm = jnp.sqrt(jax.lax.psum(sum(sq.values()), norm_axes))
+            return (new_params, new_opt), (
+                loss, drop_fraction, grad_norm, examples
+            )
+
+        (params, opt_state), outs = jax.lax.scan(
+            one, (params, opt_state), jax.random.split(key, K)
+        )
+        loss, drop_fraction, grad_norm, examples = outs
+        return params, opt_state, loss, drop_fraction, grad_norm, examples
 
     cache = {}
 
@@ -576,7 +920,7 @@ def make_pp_train_step(
         )
         return jax.jit(eval_mapped)
 
-    def step(state: PipelineState, batch: DataBatch):
+    def step(state: PipelineState, batch: DataBatch, key=None):
         if "jitted" not in cache:
             specs = _param_specs(state.params)
             opt_specs = _opt_specs(tx, state.opt_state, specs)
@@ -584,22 +928,43 @@ def make_pp_train_step(
                 local_step,
                 mesh,
                 in_specs=(specs, opt_specs,
-                          P(AXIS_DP), P(AXIS_DP), P(AXIS_DP)),
-                out_specs=(specs, opt_specs, P(), P()),
+                          P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), P()),
+                out_specs=(specs, opt_specs, P(), P(), P(), P()),
             )
             cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
             cache["eval"] = _build_eval(specs)
-        new_params, new_opt, loss, drop = cache["jitted"](
-            state.params, state.opt_state, batch.x, batch.y, batch.w
-        )
-        # Introspection hook (concrete post-jit value): the MoE
-        # capacity-drop fraction for this step; the training entry
-        # records it as moe_drop_fraction like the other trainers.
-        step.last_drop_fraction = float(drop) if has_moe else None
-        return (
-            PipelineState(step=state.step + 1, params=new_params,
-                          opt_state=new_opt),
-            loss,
+        if key is None:
+            if mini_batch is None and K == 1:
+                # The key is never consumed on this configuration —
+                # any constant avoids the device sync a
+                # device_get(state.step) fold would cost per call.
+                key = cache.setdefault("zero_key", jax.random.key(0))
+            else:
+                # Deterministic per-call key for minibatch sampling
+                # (host-side step counter seeded ONCE from the device
+                # step, so fresh blocks are drawn each call without a
+                # per-call device sync).
+                if "host_step" not in cache:
+                    cache["host_step"] = int(jax.device_get(state.step))
+                key = jax.random.fold_in(
+                    jax.random.key(0), cache["host_step"]
+                )
+                cache["host_step"] += K
+        new_params, new_opt, loss, drop, grad_norm, examples = cache[
+            "jitted"
+        ](state.params, state.opt_state, batch.x, batch.y, batch.w, key)
+        new_state = PipelineState(step=state.step + K, params=new_params,
+                                  opt_state=new_opt)
+        if K == 1:
+            # Introspection hooks (concrete post-jit values), same
+            # single-step contract as before for existing callers.
+            step.last_drop_fraction = float(drop[0]) if has_moe else None
+            step.last_grad_norm = float(grad_norm[0])
+            step.last_examples = float(examples[0])
+            return new_state, loss[0]
+        return new_state, PpStepOut(
+            loss=loss, drop_fraction=drop if has_moe else None,
+            grad_norm=grad_norm, examples=examples,
         )
 
     def eval_loss(state: PipelineState, batch: DataBatch):
@@ -713,6 +1078,9 @@ def train_distributed_pipeline(
     partition_shuffles: int = 1,
     early_stop_patience: int = -1,
     validation_pct: float = 0.0,
+    mini_batch: Optional[int] = None,
+    steps_per_call: Optional[int] = None,
+    profile_dir: Optional[str] = None,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -797,11 +1165,43 @@ def train_distributed_pipeline(
     batch = _pad_batch(x, y, w)
     n_rows_padded = int(batch.x.shape[0])
 
+    if mini_batch is not None and mini_batch > 0:
+        per_shard = n_rows_padded // dp
+        if mini_batch > per_shard:
+            raise ValueError(
+                f"mini_batch={mini_batch} exceeds the {per_shard} "
+                f"resident rows per dp shard"
+            )
+    else:
+        mini_batch = None
+
+    # Chunking mirrors the DP trainer (the shared contract lives in
+    # sync._resolve_steps_per_call): fuse many schedules per compiled
+    # call unless early stopping / validation need a signal at every
+    # step (the pp path checks those at call boundaries, so their
+    # cadence IS the chunk size).
+    from sparktorch_tpu.train.sync import _resolve_steps_per_call
+
+    steps_per_call = _resolve_steps_per_call(
+        steps_per_call,
+        default=(
+            1
+            if (early_stop_patience and early_stop_patience > 0)
+            or validation_pct > 0
+            else min(iters, 16)
+        ),
+        iters=iters,
+        checkpoint_every=checkpoint_every,
+        ckpt_active=bool(checkpoint_dir),
+    )
+
     tx = spec.make_optimizer()
     # Build the step FIRST: its config validation (stage divisibility,
     # MoE pattern uniformity, tp x MoE) produces actionable errors;
     # placement would otherwise fail earlier with a raw sharding error.
-    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head)
+    step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head,
+                              mini_batch=mini_batch,
+                              steps_per_call=steps_per_call)
     rng = jax.random.key(seed)
     flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
     pparams = pipeline_params_from_flax(flax_params, cfg)
@@ -836,11 +1236,20 @@ def train_distributed_pipeline(
     permute = jax.jit(
         lambda b, p: DataBatch(x=b.x[p], y=b.y[p], w=b.w[p])
     )
+    from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+    sample_key = jax.random.key(seed + 2 + start)
     completed = False
     stop = False
+    profiler = profile_run(profile_dir)
+    profiler.__enter__()
     try:
         for shuffle_round in range(max(1, partition_shuffles)):
-            if shuffle_round > 0:
+            # Round 0 must ALSO shuffle when minibatch sampling is on:
+            # sample_minibatch takes contiguous blocks, whose
+            # uniformity argument requires random resident order (the
+            # same invariant as the DP trainer).
+            if shuffle_round > 0 or mini_batch is not None:
                 # The reference's partition reshuffle between rounds
                 # (distributed.py:267-273): microbatch membership
                 # changes; weight-0 padding rows stay masked wherever
@@ -849,38 +1258,66 @@ def train_distributed_pipeline(
                     batch,
                     jnp.asarray(shuffle_rng.permutation(n_rows_padded)),
                 )
-            for i in range(iters):
+            i = 0
+            while i < iters:
                 t0 = time.perf_counter()
-                state, loss = step(state, batch)
+                sample_key, sub = jax.random.split(sample_key)
+                with step_annotation(i):
+                    state, out = step(state, batch, key=sub)
+                if steps_per_call == 1:
+                    losses = [float(out)]
+                    gnorms = [step.last_grad_norm]
+                    exs = [step.last_examples]
+                    drops = [step.last_drop_fraction]
+                else:
+                    losses = [float(v) for v in np.asarray(out.loss)]
+                    gnorms = [float(v) for v in np.asarray(out.grad_norm)]
+                    exs = [float(v) for v in np.asarray(out.examples)]
+                    drops = (
+                        [float(v) for v in np.asarray(out.drop_fraction)]
+                        if out.drop_fraction is not None
+                        else [None] * steps_per_call
+                    )
                 val_loss = (
                     float(step.eval_loss(state, val_batch))
                     if val_batch is not None else None
                 )
-                record = {
-                    "round": shuffle_round, "iter": i,
-                    "loss": float(loss), "val_loss": val_loss,
-                    "examples": float(n), "grad_norm": float("nan"),
-                    "step_time_s": time.perf_counter() - t0,
-                }
-                drop = getattr(step, "last_drop_fraction", None)
-                if drop is not None:
-                    record["moe_drop_fraction"] = drop
-                recorder.record(record)
-                if metrics_hook:
-                    metrics_hook(record)
-                if verbose:
-                    msg = (f"[sparktorch_tpu:pp] round {shuffle_round} "
-                           f"iter {i} loss {float(loss):.6f}")
-                    if val_loss is not None:
-                        msg += f" val_loss {val_loss:.6f}"
-                    print(msg)
+                dt = (time.perf_counter() - t0) / len(losses)
+                for j, (l, g, e, dr) in enumerate(
+                    zip(losses, gnorms, exs, drops)
+                ):
+                    record = {
+                        "round": shuffle_round, "iter": i + j,
+                        "loss": l,
+                        # val runs once per call, on the post-call
+                        # params: attach it to the chunk's last step.
+                        "val_loss": (val_loss if j == len(losses) - 1
+                                     else None),
+                        "examples": e,
+                        "grad_norm": g,
+                        "step_time_s": dt,
+                    }
+                    if dr is not None:
+                        record["moe_drop_fraction"] = dr
+                    recorder.record(record)
+                    if metrics_hook:
+                        metrics_hook(record)
+                    if verbose:
+                        msg = (f"[sparktorch_tpu:pp] round {shuffle_round} "
+                               f"iter {i + j} loss {l:.6f}")
+                        if record["val_loss"] is not None:
+                            msg += f" val_loss {record['val_loss']:.6f}"
+                        print(msg)
+                i += len(losses)
                 last_ckpt = _save_if_due(ckpt, state, last_ckpt,
                                          checkpoint_every)
                 # The global loss is replicated on every host, so the
                 # per-host stopper reaches the identical decision (no
                 # extra collective — same argument as the DP trainer).
+                # With steps_per_call > 1 the signal cadence is the
+                # call boundary (patience counts calls, not steps).
                 if stopper is not None and stopper.step(
-                    val_loss if val_loss is not None else float(loss)
+                    val_loss if val_loss is not None else losses[-1]
                 ):
                     stop = True
                     break
@@ -888,6 +1325,7 @@ def train_distributed_pipeline(
                 break
         completed = True
     finally:
+        profiler.__exit__(None, None, None)
         _finalize_checkpoint(ckpt, state, completed)
 
     trained = jax.device_get(state.params)

@@ -123,7 +123,10 @@ def _es_update(cfg: EsConfig, es: EsState, signal: jax.Array) -> EsState:
     signal = signal.astype(jnp.float32)
     first = ~es.initialized
     if cfg.percentage:
-        delta = jnp.abs(es.best) * (cfg.min_delta / 100.0)
+        # SIGNED best, matching the host stopper and the reference
+        # (early_stopper.py:48-55 uses `best * min_delta / 100`): for
+        # negative best in min mode the threshold moves toward zero.
+        delta = es.best * (cfg.min_delta / 100.0)
     else:
         delta = jnp.float32(cfg.min_delta)
     if cfg.mode == "min":

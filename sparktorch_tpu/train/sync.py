@@ -116,6 +116,26 @@ def _save_if_due(ckpt, state, last_ckpt_step: int, every: int) -> int:
     return last_ckpt_step
 
 
+def _resolve_steps_per_call(steps_per_call, default: int, iters: int,
+                            checkpoint_every: int, ckpt_active: bool) -> int:
+    """One place for the fused-chunk sizing contract (shared by the DP
+    and pipeline trainers): a DEFAULTED chunk never exceeds the
+    checkpoint cadence (saves happen between compiled calls); an
+    EXPLICIT steps_per_call wins — saves then land at chunk boundaries
+    >= the cadence (test_checkpoint_cadence_under_fused_stepping pins
+    this). The result always divides ``iters`` exactly (a fused call
+    runs its full scan; overshooting would silently train extra
+    steps)."""
+    if steps_per_call is None:
+        steps_per_call = default
+        if ckpt_active and checkpoint_every and checkpoint_every > 0:
+            steps_per_call = min(steps_per_call, checkpoint_every)
+    steps_per_call = max(1, min(int(steps_per_call), iters))
+    while iters % steps_per_call != 0:
+        steps_per_call -= 1
+    return steps_per_call
+
+
 def _finalize_checkpoint(ckpt, state, completed: bool) -> None:
     """Flush and close. The FINAL snapshot fires only on clean
     completion — orbax saves are cross-process collectives, so
@@ -173,19 +193,10 @@ def train_distributed(
         # pp>1 routes to the GPipe trainer (pipeline.py), which trains
         # the spec's CausalLM under the pipelined schedule and returns
         # ordinary flax params.
-        unsupported = {
-            "mini_batch (n_micro microbatching covers it)": bool(mini_batch),
-            "steps_per_call": steps_per_call is not None,
-            "profile_dir": bool(profile_dir),
-            "pre_sharded": pre_sharded,
-        }
-        bad = [k for k, v in unsupported.items() if v]
-        if bad:
+        if pre_sharded:
             # Fail loudly: silently dropping a knob would surprise in
             # exactly the ways that lose data or training signal.
-            raise ValueError(
-                f"not supported with pp>1 yet: {', '.join(bad)}"
-            )
+            raise ValueError("not supported with pp>1 yet: pre_sharded")
         from sparktorch_tpu.train.pipeline import train_distributed_pipeline
 
         return train_distributed_pipeline(
@@ -196,6 +207,13 @@ def train_distributed(
             partition_shuffles=partition_shuffles,
             early_stop_patience=early_stop_patience,
             validation_pct=validation_pct,
+            # -1/0 are the torch-parity "disabled" sentinels (the
+            # pp=1 paths check `mini_batch > 0`), not a request.
+            mini_batch=(mini_batch
+                        if mini_batch is not None and mini_batch > 0
+                        else None),
+            steps_per_call=steps_per_call,
+            profile_dir=profile_dir,
         )
 
     if pre_sharded:
@@ -250,21 +268,17 @@ def train_distributed(
     # post-stop steps are masked to no-ops, so the only fusion cost is
     # the masked tail of the chunk where the stop fires (hence the
     # smaller default chunk there).
-    if steps_per_call is None:
-        steps_per_call = (
+    steps_per_call = _resolve_steps_per_call(
+        steps_per_call,
+        default=(
             min(iters, 8)
             if (stopper is not None or val_batch is not None)
             else min(iters, 32)
-        )
-        if ckpt is not None and checkpoint_every > 0:
-            # Keep chunk boundaries at least as frequent as the
-            # checkpoint cadence (saves happen between compiled calls).
-            steps_per_call = min(steps_per_call, checkpoint_every)
-    steps_per_call = max(1, min(steps_per_call, iters))
-    # Chunks must divide iters exactly (a fused call always runs its
-    # full scan; overshooting would silently train extra steps).
-    while iters % steps_per_call != 0:
-        steps_per_call -= 1
+        ),
+        iters=iters,
+        checkpoint_every=checkpoint_every,
+        ckpt_active=ckpt is not None,
+    )
 
     fused_signals = steps_per_call > 1 and (
         stopper is not None or val_batch is not None

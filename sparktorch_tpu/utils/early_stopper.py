@@ -57,7 +57,10 @@ class EarlyStopping:
             if self.mode == "min":
                 return metric < self.best - self.min_delta
             return metric > self.best + self.min_delta
-        delta = abs(self.best) * self.min_delta / 100.0
+        # SIGNED best (early_stopper.py:51-56 uses `best * min_delta
+        # / 100` with no abs): for negative best the threshold moves
+        # toward zero, and the fused jax stopper matches exactly.
+        delta = self.best * self.min_delta / 100.0
         if self.mode == "min":
             return metric < self.best - delta
         return metric > self.best + delta

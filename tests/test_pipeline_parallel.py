@@ -471,3 +471,169 @@ def test_pipeline_state_checkpoint_roundtrip(tmp_path):
     # And training continues from the restored state.
     state2, loss = step(restored, batch)
     assert np.isfinite(float(loss))
+
+
+def test_pp_steps_per_call_exactness():
+    """A fused call of k schedules must equal k single-step calls
+    exactly (no minibatch sampling => fully deterministic)."""
+    import optax
+
+    cfg = _cfg(max_len=16)
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    batch = _batch(cfg)
+
+    def run(k):
+        params = init_pipeline_lm(cfg, jax.random.key(0))
+        tx = optax.adam(1e-2)
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=4,
+                                  steps_per_call=k)
+        losses = []
+        for _ in range(4 // k):
+            state, out = step(state, batch)
+            if k == 1:
+                losses.append(float(out))
+            else:
+                losses.extend(float(v) for v in np.asarray(out.loss))
+        assert int(jax.device_get(state.step)) == 4
+        return losses, jax.device_get(state.params)
+
+    l1, p1 = run(1)
+    l4, p4 = run(4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), p1, p4
+    )
+
+
+def test_pp_mini_batch_sampling():
+    """mini_batch under pp: each step trains on exactly mini_batch
+    rows per dp shard (the examples output proves it), the sampled
+    run's loss still decreases, and mini_batch == resident size is
+    exactly the unsampled step."""
+    import optax
+
+    cfg = _cfg(max_len=16)
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    batch = _batch(cfg, b=32)  # 8 resident rows per dp shard
+
+    def run(mini_batch, n_steps=6):
+        params = init_pipeline_lm(cfg, jax.random.key(0))
+        tx = optax.adam(1e-2)
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=4,
+                                  mini_batch=mini_batch)
+        losses, exs = [], []
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+            exs.append(step.last_examples)
+        return losses, exs
+
+    losses, exs = run(mini_batch=4)
+    assert all(e == 4 * 4 for e in exs), exs  # 4 rows x 4 dp shards
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # Sampling the whole resident shard is the identity.
+    l_full, exs_full = run(mini_batch=8, n_steps=2)
+    l_none, _ = run(mini_batch=None, n_steps=2)
+    assert all(e == 32 for e in exs_full), exs_full
+    np.testing.assert_allclose(l_full, l_none, rtol=1e-6)
+
+
+def test_pp_mini_batch_validation():
+    import optax
+
+    cfg = _cfg(max_len=16)
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    with np.testing.assert_raises(ValueError):
+        make_pp_train_step(cfg, optax.adam(1e-2), mesh, n_micro=4,
+                           mini_batch=6)  # not divisible by n_micro
+
+
+def test_pp_trainer_knobs_end_to_end(tmp_path):
+    """The estimator-level contract: train_distributed on a pp mesh
+    accepts mini_batch + steps_per_call + profile_dir together and
+    trains (VERDICT r03 item 4 — the full Param surface on pp)."""
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.train.sync import train_distributed
+
+    cfg = _cfg(max_len=16)
+    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-2})
+    mesh = build_mesh(MeshConfig(dp=2, pp=2), jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (32, cfg.max_len + 1)).astype(
+        np.int32
+    )
+    prof = str(tmp_path / "trace")
+    result = train_distributed(
+        spec, ids[:, :-1], labels=ids[:, 1:], mesh=mesh, iters=8,
+        n_micro=2, mini_batch=8, steps_per_call=4, profile_dir=prof,
+        seed=0,
+    )
+    losses = [m["loss"] for m in result.metrics]
+    assert len(losses) == 8
+    assert np.isfinite(losses).all()
+    # mini_batch=8 rows per dp shard x 2 dp shards
+    assert all(m["examples"] == 16.0 for m in result.metrics)
+    assert all(np.isfinite(m["grad_norm"]) for m in result.metrics)
+    import os
+
+    assert os.path.isdir(prof)  # the profiler actually wrote a trace
+
+
+def test_pp_ep_composition_parity():
+    """Experts shard ACROSS chips within a pipeline stage (VERDICT r03
+    item 5): pp=2 x ep=2 must reproduce pp=2 x ep=1 — and transitively
+    the GSPMD trainer, whose parity vs ep=1 the MoE suite pins — to
+    summation-order tolerance. SGD at lr=1 would expose any mis-scaled
+    router/aux gradient immediately; Adam loss parity covers the rest."""
+    import optax
+
+    def run(ep, n_devices, n_steps=6, opt="adam"):
+        cfg = _cfg(n_layers=4, vocab_size=64, n_experts=4, moe_every=2,
+                   moe_top_k=2)
+        mesh = build_mesh(
+            MeshConfig(dp=n_devices // (2 * ep), pp=2, ep=ep),
+            jax.devices()[:n_devices],
+        )
+        params = init_pipeline_lm(cfg, jax.random.key(0))
+        tx = optax.adam(1e-2) if opt == "adam" else optax.sgd(1.0)
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=2)
+        batch = _batch(cfg, b=8)
+        losses = []
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        return losses, jax.device_get(state.params)
+
+    l1, _ = run(ep=1, n_devices=4)
+    l2, _ = run(ep=2, n_devices=8)
+    assert l1[-1] < l1[0], l1
+    np.testing.assert_allclose(l2[:1], l1[:1], rtol=1e-5)
+    np.testing.assert_allclose(l2, l1, rtol=2e-3)
+
+    # One SGD lr=1 step: parameter-level parity (catches grad
+    # mis-scaling that loss curves can't see).
+    _, p1 = run(ep=1, n_devices=4, n_steps=1, opt="sgd")
+    _, p2 = run(ep=2, n_devices=8, n_steps=1, opt="sgd")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4,
+                                                atol=5e-6),
+        p1, p2,
+    )
+
+
+def test_pp_ep_rejects_bad_configs():
+    import optax
+
+    cfg_dense = _cfg(n_layers=4)
+    mesh = build_mesh(MeshConfig(dp=2, pp=2, ep=2), jax.devices()[:8])
+    with np.testing.assert_raises(ValueError):
+        make_pp_train_step(cfg_dense, optax.adam(1e-2), mesh, n_micro=2)
+    cfg_odd = _cfg(n_layers=4, n_experts=3, moe_every=2)
+    with np.testing.assert_raises(ValueError):
+        make_pp_train_step(cfg_odd, optax.adam(1e-2), mesh, n_micro=2)

@@ -211,3 +211,34 @@ def test_streaming_trainer_matches_ceiling():
     # pad rows are weight-0 and never counted.
     assert all(m["examples"] <= 16 * 8 + 1e-6 for m in result.metrics)
     assert sum(m["examples"] for m in result.metrics) > 0
+
+
+def test_es_percentage_mode_parity_signed_best():
+    """Percentage-mode min_delta uses SIGNED best (reference
+    early_stopper.py:51-56: ``best * min_delta / 100``, no abs): for a
+    negative best the better-threshold moves toward zero. The host
+    stopper and the fused jax stopper must agree signal-for-signal."""
+    from sparktorch_tpu.train.step import EsConfig, _es_update, init_es_state
+    from sparktorch_tpu.utils.early_stopper import EarlyStopping
+
+    # Crosses zero and hovers: exercises the signed-delta branch both
+    # sides of zero in both modes.
+    signals = [-10.0, -10.4, -10.4, -9.0, -9.3, -9.31, 2.0, 2.05, 2.2,
+               2.1, 2.1, 2.1]
+    for mode in ("min", "max"):
+        cfg = EsConfig(mode=mode, min_delta=5.0, patience=2,
+                       percentage=True)
+        host = EarlyStopping(mode=mode, min_delta=5.0, patience=2,
+                             percentage=True)
+        es = init_es_state()
+        host_stop = None
+        fused_stop = None
+        for i, s in enumerate(signals):
+            hs = host.step(s)
+            es = _es_update(cfg, es, jnp.float32(s))
+            assert abs(float(es.best) - host.best) < 1e-6, (mode, i)
+            if hs and host_stop is None:
+                host_stop = i
+            if bool(es.stopped) and fused_stop is None:
+                fused_stop = i
+        assert host_stop == fused_stop, (mode, host_stop, fused_stop)
