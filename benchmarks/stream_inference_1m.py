@@ -124,30 +124,33 @@ def main() -> None:
         # readback wire, not 1000 logits.
         postprocess=lambda y: jnp.argmax(y, axis=-1).astype(jnp.int32),
     )
-    # ZERO device->host readbacks until the very end: on this rig the
-    # tunnel's upload fast-path degrades ~50x after the FIRST readback
-    # of any size (see BatchPredictor.predict_device), so warmup and
-    # the chip-rate probe use the device-output path + block_until_
-    # ready (a sync, not a transfer).
+    # Honest timing discipline (see ROUND4_NOTES): on this rig's
+    # tunnel, dispatch and block_until_ready both under-report — only
+    # a data-dependent scalar readback truly fences. Everything below
+    # that claims a rate ends in a float(jnp.sum(...)) fence.
     out = predictor.predict_device(
         np.zeros((args.chunk, *ROW_SHAPE), np.uint8)
     )
-    out.block_until_ready()  # compile fence
+    float(jnp.sum(out))  # compile + honest fence
 
-    # Device-resident chip rate (per-chip ceiling with colocated data).
+    # Device-resident chip rate via a PAIRED-SIZE slope (the fence
+    # round-trip cancels): T(16 chunks) - T(4 chunks) over the extra
+    # 12 chunks of pure compute.
     warm = np.random.default_rng(1).integers(
-        0, 256, (4 * args.chunk, *ROW_SHAPE), dtype=np.uint8
+        0, 256, (16 * args.chunk, *ROW_SHAPE), dtype=np.uint8
     )
     xd = jax.device_put(warm)
-    xd.block_until_ready()
-    chip_rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        predictor.predict_device(xd).block_until_ready()
-        chip_rates.append(warm.shape[0] / (time.perf_counter() - t0))
-    chip_rate = max(chip_rates) / n_chips
-    print(f"chip rate (device-resident): {chip_rate:.1f} rows/s/chip",
-          flush=True)
+    float(jnp.sum(predictor.predict_device(xd[: 4 * args.chunk])))  # warm
+    t0 = time.perf_counter()
+    float(jnp.sum(predictor.predict_device(xd[: 4 * args.chunk])))
+    t_small = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(jnp.sum(predictor.predict_device(xd)))
+    t_big = time.perf_counter() - t0
+    chip_rate = 12 * args.chunk / max(t_big - t_small, 1e-9) / n_chips
+    del xd, warm
+    print(f"chip rate (device-resident, paired-size slope): "
+          f"{chip_rate:.1f} rows/s/chip", flush=True)
 
     # Predictions accumulate into ONE device buffer (int32 per row =
     # 4 MB at 1M rows) via a donated dynamic_update_slice; the single
@@ -163,12 +166,18 @@ def main() -> None:
     )
 
     st = load_state(args.state)
-    print(f"resume state: {st['rows_done']} rows already done", flush=True)
+    resume_start = int(st["rows_done"])
+    print(f"resume state: {resume_start} rows already done", flush=True)
+    if resume_start:
+        print("note: predictions for pre-resume rows are not retained "
+              "across processes (rate metrics are; the final histogram "
+              "covers only this process's rows)", flush=True)
 
     base_elapsed = float(st.get("elapsed_s", 0.0))
     t_run0 = time.perf_counter()
     last_save = [t_run0]
     nonlocal_buf = [result_buf]
+    pending_fence = [None]
 
     def snapshot():
         st["elapsed_s"] = base_elapsed + (time.perf_counter() - t_run0)
@@ -181,10 +190,18 @@ def main() -> None:
                    args.rows - st["rows_done"])
 
         def drain(out):
-            # `out` is a DEVICE array (no readback here — see above);
-            # park it in the big on-device result buffer.
+            # `out` is a DEVICE array; park it in the big on-device
+            # result buffer. The lag-1 scalar fence keeps dispatch
+            # honest AND bounds in-flight device buffers to ~2 reader
+            # batches (block_until_ready under-blocks on this rig, so
+            # a real data-dependent readback is the only backpressure
+            # that works; it costs one round-trip per 1024 rows —
+            # ~1-3% of the batch's 15 s of wire time).
             nonlocal_buf[0] = _acc(nonlocal_buf[0], out,
                                    st["rows_done"] % args.rows)
+            fence, pending_fence[0] = pending_fence[0], jnp.sum(out)
+            if fence is not None:
+                float(fence)
             st["rows_done"] += out.shape[0]
             now = time.perf_counter()
             if now - last_save[0] >= 30.0:
@@ -217,9 +234,11 @@ def main() -> None:
     dl_s = time.perf_counter() - t_dl
     st["elapsed_s"] = base_elapsed + (time.perf_counter() - t_run0)
     save_state(args.state, st)
+    own = preds[resume_start % args.rows : st["rows_done"]]
+    head = own[:10000] if own.size else preds[:1]
     print(f"final download: {preds.nbytes/1e6:.1f} MB of predictions "
-          f"in {dl_s:.2f}s (class histogram head: "
-          f"{np.bincount(preds[:10000] % 10)[:5].tolist()})", flush=True)
+          f"in {dl_s:.2f}s (class histogram head, this process's rows: "
+          f"{np.bincount(head % 10)[:5].tolist()})", flush=True)
 
     wall = st["elapsed_s"]
     rate = st["rows_done"] / max(wall, 1e-9)

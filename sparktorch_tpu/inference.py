@@ -164,22 +164,21 @@ class BatchPredictor:
         return np.concatenate(host) if len(host) > 1 else host[0]
 
     def predict_device(self, x, in_flight: int = 3):
-        """Chunked forward with ZERO device->host readbacks: returns
-        ONE device array of predictions (padding trimmed), leaving the
-        download to the caller.
+        """Chunked forward with no device->host readbacks: returns ONE
+        device array of predictions (padding trimmed), leaving the
+        download — and therefore the sync cadence — to the caller.
 
-        Why this exists: on tunnel-attached chips the host->device
-        upload fast-path degrades by ~50x after the FIRST device->host
-        readback of any size — even a scalar (measured on this rig:
-        1.4 GB/s before, ~6-25 MB/s after; see ROUND4_NOTES). The
-        ordinary ``predict`` interleaves a readback per chunk, so a
-        long upload-streaming run (BASELINE config 5) gets wire-bound
-        at ~50 rows/s. This path keeps every chunk's output on device
-        — pacing the pipeline with ``block_until_ready`` (a sync, not
-        a transfer, which does NOT trigger the degradation) so at most
-        ``in_flight`` chunks of input occupy HBM — and the caller
-        downloads results once, after the stream, when upload speed no
-        longer matters."""
+        Why this exists: on tunnel-attached chips every readback costs
+        a full link round-trip, and dispatch/block_until_ready UNDER-
+        report (async work queues without executing — ROUND4_NOTES,
+        'honest timing'). The ordinary ``predict`` interleaves one
+        readback per chunk; this path emits none, so a long streaming
+        run can fence at its own cadence (e.g. one data-dependent
+        scalar per reader batch — the only fence that truly bounds the
+        queue on this platform) instead of once per chunk.
+        ``in_flight`` paces via ``block_until_ready`` as best-effort
+        backpressure; callers needing a HARD bound must fence with a
+        readback themselves (see benchmarks/stream_inference_1m.py)."""
         n = x.shape[0]
         if n == 0:
             # Shape probe WITHOUT the readback predict() does — one

@@ -1,21 +1,30 @@
-"""GPipe pipeline parallelism over the ``pp`` mesh axis, composable
-with tensor parallelism over ``tp``.
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe and 1F1B
+schedules), composable with tensor parallelism over ``tp`` and —
+for MoE stacks — expert parallelism over ``ep``.
 
 No reference counterpart (SURVEY §2.4: PP "absent"). TPU-first
 design: the transformer stack is split into ``pp`` stages — the
 stacked per-layer params are sharded over ``pp`` on their leading
-(layer) dim — and a ``shard_map`` step runs the classic GPipe
-schedule: microbatches enter at stage 0, activations hop stage→stage
-on an ICI ring via ``lax.ppermute``, the last stage accumulates the
-weighted loss, and autodiff THROUGH the schedule (ppermute transposes
-to the reverse permute) yields exact gradients — mathematically
-identical to gradient accumulation over the microbatches on one
-device, which is what the parity test asserts.
+(layer) dim — and a ``shard_map`` step runs the schedule:
+microbatches enter at stage 0, activations hop stage→stage on an ICI
+ring via ``lax.ppermute``, the last stage accumulates the weighted
+loss.
 
-The whole schedule (M + S - 1 ticks) is one ``lax.scan`` inside one
-jitted ``shard_map``: zero per-tick Python, static shapes, and the
-bubble is the textbook (S-1)/(M+S-1) fraction — raise ``n_micro`` to
-shrink it.
+Two schedules, identical math (exactness-tested against each other):
+
+- ``gpipe`` (default): the whole schedule (M + S - 1 ticks) is one
+  ``lax.scan`` and autodiff THROUGH it (ppermute transposes to the
+  reverse permute) yields exact gradients; activation memory scales
+  with M (the scan saves per-tick carries).
+- ``1f1b``: a combined-tick 1F1B schedule (M + 2S - 2 ticks) with a
+  MANUAL backward — each backward tick re-runs its stage forward
+  under ``jax.vjp``, so only the stage inputs of in-flight
+  microbatches persist, in a ring of 2S - 1 slots: activation memory
+  scales with S, not M (measured via XLA memory_analysis in the
+  tests). FLOPs match remat-GPipe.
+
+Zero per-tick Python, static shapes; the GPipe bubble is the textbook
+(S-1)/(M+S-1) fraction — raise ``n_micro`` to shrink it.
 
 Within a stage the encoder layer is computed in explicit einsum form
 (same math and param tree as ``models.transformer.EncoderLayer``) so
@@ -516,6 +525,7 @@ def make_pp_train_step(
     head: str = "lm",
     mini_batch: Optional[int] = None,
     steps_per_call: int = 1,
+    schedule: str = "gpipe",
 ) -> Callable[[PipelineState, DataBatch], Tuple[PipelineState, jax.Array]]:
     """Build the jitted pipelined train step over ``mesh`` (dp x pp x
     tp; other axes must be 1 for this trainer).
@@ -534,6 +544,8 @@ def make_pp_train_step(
     with per-step arrays."""
     if head not in ("lm", "classifier"):
         raise ValueError(f"unknown head {head!r}")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     K = max(1, int(steps_per_call))
     if mini_batch is not None and mini_batch > 0:
         if mini_batch % n_micro != 0:
@@ -566,6 +578,11 @@ def make_pp_train_step(
         raise ValueError(
             "mesh ep>1 needs MoE layers (n_experts>0) — there are no "
             "experts to shard"
+        )
+    if schedule == "1f1b" and has_moe:
+        raise ValueError(
+            "the 1f1b schedule supports dense stacks only for now; "
+            "use schedule='gpipe' for MoE layers"
         )
     if has_moe:
         if T > 1:
@@ -791,11 +808,172 @@ def make_pp_train_step(
 
         return pipeline_loss(params)
 
+    def one_f_one_b_grads(params, x, y, w):
+        """1F1B schedule with a MANUAL backward: loss + gradients of
+        the same math as ``schedule_loss`` (exactness-tested), with
+        activation memory O(pp) instead of the O(n_micro) that
+        autodiff-through-the-GPipe-scan stores.
+
+        Combined-tick form: T = M + 2(S-1) ticks; at tick t stage s
+        forwards microbatch ``t - s`` and backwards microbatch
+        ``t - 2(S-1) + s`` (the last stage backwards a microbatch the
+        same tick it forwards it). Each backward re-runs the stage
+        forward under ``jax.vjp`` — residuals live only within the
+        tick — so only the stage INPUTS of in-flight microbatches are
+        stored, in a ring of ``2S-1`` slots. FLOPs match remat-GPipe
+        (1 forward + recompute-backward per microbatch per stage);
+        ticks are (M+2S-2) vs GPipe's (M+S-1) fused fwd+bwd ticks.
+
+        Gradients accumulate for the SUM of weighted losses (num) and
+        are scaled by the global weight den afterwards (den is
+        params-independent), exactly reproducing num_g/max(den_g, 1).
+        """
+        stage = jax.lax.axis_index(AXIS_PP)
+        b_local, s_len = x.shape
+        if b_local % n_micro != 0:
+            raise ValueError(
+                f"local batch {b_local} not divisible by n_micro={n_micro}"
+            )
+        mb = b_local // n_micro
+        micro_x = x.reshape(n_micro, mb, s_len)
+        micro_y = y.reshape((n_micro, mb) + y.shape[1:])
+        micro_w = w.reshape(n_micro, mb)
+        M = n_micro
+        R = 2 * S - 1  # ring capacity >= max in-flight microbatches
+        fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+        bwd_ring = [(i, (i - 1) % S) for i in range(S)]
+
+        def stage_out(p, h_in):
+            return stage_fn(p["layers"], h_in)
+
+        def last_num(p, h_in, yy, ww):
+            num, _ = head_loss(p, stage_out(p, h_in), yy, ww)
+            return num
+
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            ring, fwd_ch, bwd_ch, grads, num, den = carry
+
+            # ---- forward sub-tick: microbatch t - stage ----
+            m_f = t - stage
+            fwd_valid = (m_f >= 0) & (m_f < M)
+            mi_f = jnp.clip(m_f, 0, M - 1)
+
+            def do_fwd():
+                h_in = jax.lax.cond(
+                    stage == 0,
+                    lambda: embed(params, micro_x[mi_f]),
+                    lambda: fwd_ch,
+                )
+                h_out = stage_out(params, h_in)
+                n_, d_ = jax.lax.cond(
+                    stage == S - 1,
+                    lambda: head_loss(params, h_out,
+                                      micro_y[mi_f], micro_w[mi_f]),
+                    lambda: (jnp.zeros(()), jnp.zeros(())),
+                )
+                return h_in, h_out, n_, d_
+
+            def skip_fwd():
+                z = jnp.zeros((mb, s_len, cfg.d_model), dt)
+                return z, z, jnp.zeros(()), jnp.zeros(())
+
+            h_in, h_out, n_, d_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
+            num = num + n_
+            den = den + d_
+            ring = jnp.where(
+                fwd_valid,
+                jax.lax.dynamic_update_slice(
+                    ring, h_in[None], (mi_f % R, 0, 0, 0)
+                ),
+                ring,
+            )
+
+            # ---- backward sub-tick: microbatch t - 2(S-1) + stage ----
+            m_b = t - 2 * (S - 1) + stage
+            bwd_valid = (m_b >= 0) & (m_b < M)
+            mi_b = jnp.clip(m_b, 0, M - 1)
+
+            def do_bwd():
+                h_saved = jax.lax.dynamic_index_in_dim(
+                    ring, mi_b % R, axis=0, keepdims=False
+                )
+
+                def bwd_last():
+                    _, pull = jax.vjp(
+                        lambda p, h: last_num(p, h, micro_y[mi_b],
+                                              micro_w[mi_b]),
+                        params, h_saved,
+                    )
+                    return pull(jnp.ones(()))
+
+                def bwd_mid():
+                    _, pull = jax.vjp(stage_out, params, h_saved)
+                    return pull(bwd_ch)
+
+                ct_params, ct_h = jax.lax.cond(
+                    stage == S - 1, bwd_last, bwd_mid
+                )
+                # Stage 0 folds its input cotangent into the embedding
+                # tables (its "previous stage").
+                def embed_grads():
+                    _, pull = jax.vjp(
+                        lambda p: embed(p, micro_x[mi_b]), params
+                    )
+                    return pull(ct_h)[0]
+
+                ct_params = jax.lax.cond(
+                    stage == 0,
+                    lambda: jax.tree.map(jnp.add, ct_params,
+                                         embed_grads()),
+                    lambda: ct_params,
+                )
+                return ct_params, ct_h
+
+            def skip_bwd():
+                return zero_grads, jnp.zeros((mb, s_len, cfg.d_model), dt)
+
+            ct_params, ct_h = jax.lax.cond(bwd_valid, do_bwd, skip_bwd)
+            grads = jax.tree.map(jnp.add, grads, ct_params)
+
+            fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
+            bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
+            return (ring, fwd_next, bwd_next, grads, num, den), None
+
+        init = (
+            jnp.zeros((R, mb, s_len, cfg.d_model), dt),
+            jnp.zeros((mb, s_len, cfg.d_model), dt),
+            jnp.zeros((mb, s_len, cfg.d_model), dt),
+            zero_grads,
+            jnp.zeros(()),
+            jnp.zeros(()),
+        )
+        (_, _, _, grads, num, den), _ = jax.lax.scan(
+            tick, init, jnp.arange(M + 2 * (S - 1))
+        )
+        num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
+        den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
+        den_safe = jnp.maximum(den_g, 1.0)
+        loss = num_g / den_safe
+        grads = jax.tree.map(lambda g: g / den_safe, grads)
+        return loss, den_g, grads
+
     def local_step(params, opt_state, x, y, w, key):
         dp_idx = jax.lax.axis_index(AXIS_DP)
 
         def one(carry, sub):
             params, opt_state = carry
+            if (mini_batch is not None and mini_batch > 0
+                    and mini_batch > x.shape[0]):
+                # Fail loudly (trace-time): silently training on the
+                # full resident batch would be the quiet failure mode
+                # the knob contract forbids. == resident size is the
+                # documented identity case.
+                raise ValueError(
+                    f"mini_batch={mini_batch} exceeds the {x.shape[0]} "
+                    "resident rows per dp shard"
+                )
             if mini_batch is not None and 0 < mini_batch < x.shape[0]:
                 from sparktorch_tpu.utils.data import sample_minibatch
 
@@ -808,9 +986,29 @@ def make_pp_train_step(
                 )
             else:
                 b = DataBatch(x=x, y=y, w=w)
-            (loss, (drop_fraction, _, examples)), grads = jax.value_and_grad(
-                lambda p: schedule_loss(p, b.x, b.y, b.w), has_aux=True
-            )(params)
+            if schedule == "1f1b":
+                loss, examples, grads = one_f_one_b_grads(
+                    params, b.x, b.y, b.w
+                )
+                drop_fraction = jnp.zeros(())
+            else:
+                (loss, (drop_fraction, _, examples)), grads = (
+                    jax.value_and_grad(
+                        lambda p: schedule_loss(p, b.x, b.y, b.w),
+                        has_aux=True,
+                    )(params)
+                )
+                # psum under shard_map autodiff transposes to psum, so
+                # the cotangent of the (pp, dp)-psummed loss arrives
+                # SUMMED over those S*dp members: without this
+                # normalization the effective gradient (and therefore
+                # the SGD learning rate) grew linearly with mesh size.
+                # Found by the 1f1b exactness test, whose manual
+                # backward computes the honest mesh-size-invariant
+                # gradient; dp=1/pp=1 agreement pins the right scale.
+                grads = jax.tree.map(
+                    lambda g: g / (S * mesh.shape[AXIS_DP]), grads
+                )
             # Replicated-param grads must be summed over every axis
             # the param is replicated across: layer stacks live on one
             # pp shard each (sum over dp only); embed/head/norm are
@@ -920,7 +1118,7 @@ def make_pp_train_step(
         )
         return jax.jit(eval_mapped)
 
-    def step(state: PipelineState, batch: DataBatch, key=None):
+    def _ensure_built(state: PipelineState):
         if "jitted" not in cache:
             specs = _param_specs(state.params)
             opt_specs = _opt_specs(tx, state.opt_state, specs)
@@ -933,6 +1131,20 @@ def make_pp_train_step(
             )
             cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
             cache["eval"] = _build_eval(specs)
+
+    def memory_analysis(state: PipelineState, batch: DataBatch, key=None):
+        """XLA's memory analysis of the compiled train step (temp
+        allocation bytes etc.) — how the 1f1b-vs-gpipe activation-
+        memory claim is MEASURED rather than asserted. Call before
+        stepping (lowering uses the live buffers; no donation)."""
+        _ensure_built(state)
+        k = key if key is not None else jax.random.key(0)
+        return cache["jitted"].lower(
+            state.params, state.opt_state, batch.x, batch.y, batch.w, k
+        ).compile().memory_analysis()
+
+    def step(state: PipelineState, batch: DataBatch, key=None):
+        _ensure_built(state)
         if key is None:
             if mini_batch is None and K == 1:
                 # The key is never consumed on this configuration —
@@ -973,6 +1185,7 @@ def make_pp_train_step(
         return cache["eval"](state.params, batch.x, batch.y, batch.w)
 
     step.eval_loss = eval_loss
+    step.memory_analysis = memory_analysis
     return step
 
 
@@ -1081,6 +1294,7 @@ def train_distributed_pipeline(
     mini_batch: Optional[int] = None,
     steps_per_call: Optional[int] = None,
     profile_dir: Optional[str] = None,
+    schedule: str = "gpipe",
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -1201,7 +1415,8 @@ def train_distributed_pipeline(
     # placement would otherwise fail earlier with a raw sharding error.
     step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head,
                               mini_batch=mini_batch,
-                              steps_per_call=steps_per_call)
+                              steps_per_call=steps_per_call,
+                              schedule=schedule)
     rng = jax.random.key(seed)
     flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
     pparams = pipeline_params_from_flax(flax_params, cfg)
