@@ -168,6 +168,13 @@ def main() -> None:
     st = load_state(args.state)
     resume_start = int(st["rows_done"])
     print(f"resume state: {resume_start} rows already done", flush=True)
+    if resume_start >= args.rows:
+        # Re-invoked after completion: nothing to run, and appending a
+        # no-work row (with an all-zeros histogram from the fresh
+        # buffer) would corrupt the log.
+        print(f"already complete ({resume_start} >= {args.rows}); "
+              f"nothing to do — see {args.out}", flush=True)
+        return
     if resume_start:
         print("note: predictions for pre-resume rows are not retained "
               "across processes (rate metrics are; the final histogram "

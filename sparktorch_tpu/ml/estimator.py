@@ -212,7 +212,8 @@ class SparkTorch(Estimator):
                  mode=None, device=None, acquireLock=None, partitionShuffles=None,
                  port=None, useBarrier=None, useVectorOut=None,
                  earlyStopPatience=None, miniBatch=None, validationPct=None,
-                 pushEvery=None, mesh=None, seed=None, n_micro=None):
+                 pushEvery=None, mesh=None, seed=None, n_micro=None,
+                 pipeline_schedule=None):
         super().__init__()
         # Defaults mirror torch_distributed.py:178-196.
         self._setDefault(
@@ -239,6 +240,8 @@ class SparkTorch(Estimator):
         # pp>1 (like mesh/seed, a driver-side object, not an ML Param).
         n_micro = kwargs.pop("n_micro", None)
         self._n_micro = 4 if n_micro is None else int(n_micro)
+        sched = kwargs.pop("pipeline_schedule", None)
+        self._pipeline_schedule = "gpipe" if sched is None else str(sched)
         self._set(**kwargs)
 
     @keyword_only
@@ -254,6 +257,10 @@ class SparkTorch(Estimator):
             n_micro = kwargs.pop("n_micro")
             if n_micro is not None:
                 self._n_micro = int(n_micro)
+        if "pipeline_schedule" in kwargs:
+            sched = kwargs.pop("pipeline_schedule")
+            if sched is not None:
+                self._pipeline_schedule = str(sched)
         return self._set(**kwargs)
 
     # -- getters (torch_distributed.py:224-264 parity) ----------------------
@@ -340,6 +347,7 @@ class SparkTorch(Estimator):
                 seed=self._seed,
                 device=self.getDevice(),
                 n_micro=self._n_micro,
+                pipeline_schedule=self._pipeline_schedule,
             )
         elif mode in ("hogwild", "async"):
             from sparktorch_tpu.train.hogwild import train_async

@@ -173,14 +173,16 @@ def train_distributed(
     profile_dir: Optional[str] = None,
     pre_sharded: bool = False,
     n_micro: int = 4,
+    pipeline_schedule: str = "gpipe",
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
     Parameter surface mirrors ``train_distributed``
     (``distributed.py:209-236``): iters, partition_shuffles, verbose,
     mini_batch, validation_pct, early_stop_patience. ``world_size`` and
-    ``device`` disappear — the mesh defines the world. ``n_micro``
-    applies only when the mesh has pp>1 (GPipe microbatch count).
+    ``device`` disappear — the mesh defines the world. ``n_micro`` and
+    ``pipeline_schedule`` ('gpipe' | '1f1b') apply only when the mesh
+    has pp>1.
     """
     del device
     spec = deserialize_model(torch_obj)
@@ -214,6 +216,7 @@ def train_distributed(
                         else None),
             steps_per_call=steps_per_call,
             profile_dir=profile_dir,
+            schedule=pipeline_schedule,
         )
 
     if pre_sharded:

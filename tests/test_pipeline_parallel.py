@@ -745,3 +745,47 @@ def test_pp_grad_scale_mesh_invariant():
                                                     atol=1e-6),
             ref, got,
         )
+
+
+def test_1f1b_classifier_and_estimator_surface():
+    """1f1b with the classifier head matches gpipe, and the schedule
+    is reachable from the public surface (train_distributed's
+    pipeline_schedule and the estimator kwarg)."""
+    import optax
+
+    from sparktorch_tpu.ml.estimator import SparkTorch
+    from sparktorch_tpu.models.transformer import SequenceClassifier
+    from sparktorch_tpu.train.pipeline import init_pipeline_classifier
+    from sparktorch_tpu.utils.serde import serialize_model
+
+    cfg = _cfg(n_classes=2, causal=False)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (16, cfg.max_len)).astype(np.int32)
+    labels = (ids.sum(1) % 2).astype(np.int32)
+    batch = DataBatch(x=jnp.asarray(ids), y=jnp.asarray(labels),
+                      w=jnp.ones((16,), jnp.float32))
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+
+    def run(sched):
+        params = init_pipeline_classifier(cfg, jax.random.key(0))
+        tx = optax.adam(1e-2)
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=4,
+                                  head="classifier", schedule=sched)
+        losses = []
+        for _ in range(3):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=1e-5)
+
+    payload = serialize_model(SequenceClassifier(cfg), "cross_entropy",
+                              "adam", {"lr": 1e-2},
+                              input_shape=(cfg.max_len,))
+    est = SparkTorch(inputCol="features", labelCol="label",
+                     torchObj=payload, iters=4, mesh=mesh,
+                     pipeline_schedule="1f1b")
+    est.fit({"features": list(ids), "label": labels.astype(np.float32)})
+    losses = [m["loss"] for m in est._last_metrics]
+    assert len(losses) == 4 and np.isfinite(losses).all()
