@@ -186,9 +186,19 @@ def main() -> None:
     nonlocal_buf = [result_buf]
     pending_fence = [None]
 
-    def snapshot():
+    # Two counters: rows_done advances at DISPATCH (it drives the
+    # device-buffer offsets), but persisted state only ever records
+    # FENCED rows — work whose data-dependent readback completed — so
+    # a crash can never mark never-executed rows as done (execution is
+    # FIFO: consuming batch k's fence proves every batch <= k ran).
+    fenced = [resume_start]
+
+    def snapshot(final: bool = False):
         st["elapsed_s"] = base_elapsed + (time.perf_counter() - t_run0)
-        save_state(args.state, st)
+        persist = dict(st)
+        if not final:
+            persist["rows_done"] = min(st["rows_done"], fenced[0])
+        save_state(args.state, persist)
 
     while st["rows_done"] < args.rows:
         pass_start_rows = st["rows_done"]
@@ -204,12 +214,16 @@ def main() -> None:
             # a real data-dependent readback is the only backpressure
             # that works; it costs one round-trip per 1024 rows —
             # ~1-3% of the batch's 15 s of wire time).
-            nonlocal_buf[0] = _acc(nonlocal_buf[0], out,
-                                   st["rows_done"] % args.rows)
-            fence, pending_fence[0] = pending_fence[0], jnp.sum(out)
+            start = st["rows_done"]
+            nonlocal_buf[0] = _acc(nonlocal_buf[0], out, start % args.rows)
+            fence, pending_fence[0] = (
+                pending_fence[0],
+                (jnp.sum(out), start + out.shape[0]),
+            )
             if fence is not None:
-                float(fence)
-            st["rows_done"] += out.shape[0]
+                float(fence[0])
+                fenced[0] = fence[1]
+            st["rows_done"] = start + out.shape[0]
             now = time.perf_counter()
             if now - last_save[0] >= 30.0:
                 last_save[0] = now
