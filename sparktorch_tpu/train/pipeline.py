@@ -21,7 +21,8 @@ Two schedules, identical math (exactness-tested against each other):
   under ``jax.vjp``, so only the stage inputs of in-flight
   microbatches persist, in a ring of 2S - 1 slots: activation memory
   scales with S, not M (measured via XLA memory_analysis in the
-  tests). FLOPs match remat-GPipe.
+  tests). FLOPs match remat-GPipe. MoE stacks (and ep sharding)
+  compose — the aux loss and drop counts ride the manual backward.
 
 Zero per-tick Python, static shapes; the GPipe bubble is the textbook
 (S-1)/(M+S-1) fraction — raise ``n_micro`` to shrink it.
@@ -579,11 +580,6 @@ def make_pp_train_step(
             "mesh ep>1 needs MoE layers (n_experts>0) — there are no "
             "experts to shard"
         )
-    if schedule == "1f1b" and has_moe:
-        raise ValueError(
-            "the 1f1b schedule supports dense stacks only for now; "
-            "use schedule='gpipe' for MoE layers"
-        )
     if has_moe:
         if T > 1:
             raise ValueError(
@@ -827,6 +823,15 @@ def make_pp_train_step(
         Gradients accumulate for the SUM of weighted losses (num) and
         are scaled by the global weight den afterwards (den is
         params-independent), exactly reproducing num_g/max(den_g, 1).
+
+        MoE stacks compose: each valid tick processes a REAL
+        microbatch (bubbles are cond-skipped, so no zero-token-weight
+        masking is needed, unlike the GPipe scan), the sown aux loss
+        and drop counts accumulate in the forward sub-ticks, and the
+        backward seeds the aux output with ``den_safe/(n_micro*dp)``
+        so ONE pullback covers both the task path (later divided by
+        den) and the aux path (whose GPipe weight is 1/(n_micro*dp))
+        — den is params-independent and computable up front.
         """
         stage = jax.lax.axis_index(AXIS_PP)
         b_local, s_len = x.shape
@@ -843,17 +848,38 @@ def make_pp_train_step(
         fwd_ring = [(i, (i + 1) % S) for i in range(S)]
         bwd_ring = [(i, (i - 1) % S) for i in range(S)]
 
-        def stage_out(p, h_in):
-            return stage_fn(p["layers"], h_in)
+        # den is the global weight sum — schedule-independent (w is
+        # replicated across pp), so the aux seed below can use it.
+        den_g = jax.lax.psum(jnp.sum(w), AXIS_DP)
+        den_safe = jnp.maximum(den_g, 1.0)
+        dp_n = jax.lax.axis_size(AXIS_DP)
+        aux_seed = den_safe / (n_micro * dp_n)
 
-        def last_num(p, h_in, yy, ww):
-            num, _ = head_loss(p, stage_out(p, h_in), yy, ww)
-            return num
+        def stage_out(p, h_in, tw):
+            """(h_out, aux, dropped, routed) — zeros for dense."""
+            if has_moe:
+                return stage_fn_moe(p, h_in, tw)
+            z = jnp.zeros(())
+            return stage_fn(p["layers"], h_in), z, z, z
+
+        def last_outs(p, h_in, yy, ww, tw):
+            """(num, aux) of the last stage — the two differentiated
+            outputs; den/drop-counts are params-independent."""
+            h_out, aux, _, _ = stage_out(p, h_in, tw)
+            num, _ = head_loss(p, h_out, yy, ww)
+            return num, aux
+
+        def mid_outs(p, h_in, tw):
+            h_out, aux, _, _ = stage_out(p, h_in, tw)
+            return h_out, aux
+
+        def tw_of(ww):
+            return jnp.broadcast_to(ww[:, None], (mb, s_len))
 
         zero_grads = jax.tree.map(jnp.zeros_like, params)
 
         def tick(carry, t):
-            ring, fwd_ch, bwd_ch, grads, num, den = carry
+            ring, fwd_ch, bwd_ch, grads, num, aux, dr, rt = carry
 
             # ---- forward sub-tick: microbatch t - stage ----
             m_f = t - stage
@@ -866,22 +892,28 @@ def make_pp_train_step(
                     lambda: embed(params, micro_x[mi_f]),
                     lambda: fwd_ch,
                 )
-                h_out = stage_out(params, h_in)
-                n_, d_ = jax.lax.cond(
+                h_out, a_, dr_, rt_ = stage_out(params, h_in,
+                                                tw_of(micro_w[mi_f]))
+                n_ = jax.lax.cond(
                     stage == S - 1,
                     lambda: head_loss(params, h_out,
-                                      micro_y[mi_f], micro_w[mi_f]),
-                    lambda: (jnp.zeros(()), jnp.zeros(())),
+                                      micro_y[mi_f], micro_w[mi_f])[0],
+                    lambda: jnp.zeros(()),
                 )
-                return h_in, h_out, n_, d_
+                return h_in, h_out, n_, a_, dr_, rt_
 
             def skip_fwd():
                 z = jnp.zeros((mb, s_len, cfg.d_model), dt)
-                return z, z, jnp.zeros(()), jnp.zeros(())
+                zs = jnp.zeros(())
+                return z, z, zs, zs, zs, zs
 
-            h_in, h_out, n_, d_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
+            h_in, h_out, n_, a_, dr_, rt_ = jax.lax.cond(
+                fwd_valid, do_fwd, skip_fwd
+            )
             num = num + n_
-            den = den + d_
+            aux = aux + a_
+            dr = dr + dr_
+            rt = rt + rt_
             ring = jnp.where(
                 fwd_valid,
                 jax.lax.dynamic_update_slice(
@@ -899,18 +931,24 @@ def make_pp_train_step(
                 h_saved = jax.lax.dynamic_index_in_dim(
                     ring, mi_b % R, axis=0, keepdims=False
                 )
+                tw_b = tw_of(micro_w[mi_b])
 
                 def bwd_last():
                     _, pull = jax.vjp(
-                        lambda p, h: last_num(p, h, micro_y[mi_b],
-                                              micro_w[mi_b]),
+                        lambda p, h: last_outs(p, h, micro_y[mi_b],
+                                               micro_w[mi_b], tw_b),
                         params, h_saved,
                     )
-                    return pull(jnp.ones(()))
+                    # Seeds: d(num)=1; aux pre-scaled by den_safe so
+                    # the final /den_safe nets the GPipe aux weight.
+                    return pull((jnp.ones(()), aux_seed))
 
                 def bwd_mid():
-                    _, pull = jax.vjp(stage_out, params, h_saved)
-                    return pull(bwd_ch)
+                    _, pull = jax.vjp(
+                        lambda p, h: mid_outs(p, h, tw_b),
+                        params, h_saved,
+                    )
+                    return pull((bwd_ch, aux_seed))
 
                 ct_params, ct_h = jax.lax.cond(
                     stage == S - 1, bwd_last, bwd_mid
@@ -939,25 +977,33 @@ def make_pp_train_step(
 
             fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
             bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
-            return (ring, fwd_next, bwd_next, grads, num, den), None
+            return (ring, fwd_next, bwd_next, grads, num, aux, dr, rt), None
 
         init = (
             jnp.zeros((R, mb, s_len, cfg.d_model), dt),
             jnp.zeros((mb, s_len, cfg.d_model), dt),
             jnp.zeros((mb, s_len, cfg.d_model), dt),
             zero_grads,
-            jnp.zeros(()),
-            jnp.zeros(()),
+            jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), jnp.zeros(()),
         )
-        (_, _, _, grads, num, den), _ = jax.lax.scan(
+        (_, _, _, grads, num, aux, dr, rt), _ = jax.lax.scan(
             tick, init, jnp.arange(M + 2 * (S - 1))
         )
         num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
-        den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
-        den_safe = jnp.maximum(den_g, 1.0)
         loss = num_g / den_safe
+        if has_moe:
+            # Same accounting as the GPipe schedule_loss: stages hold
+            # disjoint MoE layers (psum over pp), mean over
+            # microbatches and dp shards.
+            aux_g = jax.lax.psum(aux, (AXIS_PP, AXIS_DP))
+            loss = loss + aux_g / (n_micro * dp_n)
+            dr_g = jax.lax.psum(dr, (AXIS_PP, AXIS_DP))
+            rt_g = jax.lax.psum(rt, (AXIS_PP, AXIS_DP))
+            drop_fraction = dr_g / jnp.maximum(rt_g, 1.0)
+        else:
+            drop_fraction = jnp.zeros(())
         grads = jax.tree.map(lambda g: g / den_safe, grads)
-        return loss, den_g, grads
+        return loss, den_g, grads, drop_fraction
 
     def local_step(params, opt_state, x, y, w, key):
         dp_idx = jax.lax.axis_index(AXIS_DP)
@@ -987,10 +1033,9 @@ def make_pp_train_step(
             else:
                 b = DataBatch(x=x, y=y, w=w)
             if schedule == "1f1b":
-                loss, examples, grads = one_f_one_b_grads(
+                loss, examples, grads, drop_fraction = one_f_one_b_grads(
                     params, b.x, b.y, b.w
                 )
-                drop_fraction = jnp.zeros(())
             else:
                 (loss, (drop_fraction, _, examples)), grads = (
                     jax.value_and_grad(
