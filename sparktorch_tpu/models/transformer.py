@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from sparktorch_tpu.ops.attention import dense_attention, ring_attention
-from sparktorch_tpu.parallel.mesh import BATCH_AXES
+from sparktorch_tpu.parallel.mesh import AXIS_EP, BATCH_AXES
 
 
 
@@ -63,6 +63,21 @@ class TransformerConfig:
     # the dispatch/combine one-hots are O(n * group * cf) elements —
     # linear in total tokens — instead of O(n^2) with global routing.
     moe_group_size: int = 4096
+    # How tokens reach their experts across the ``ep`` mesh axis in the
+    # pipeline trainer's manual MoE path (train/pipeline.py):
+    # 'a2a'       — GShard-style: each ep member routes only its own
+    #               slice of the routing groups and token blocks travel
+    #               to their experts' owners over an all_to_all (and
+    #               back) — per-member routing/dispatch work and
+    #               activation bytes scale 1/ep;
+    # 'replicate' — every member routes the full batch and computes its
+    #               expert slice, one psum combines (the round-4
+    #               layout; correct but does not shrink with ep);
+    # 'auto'      — 'a2a' when the group count divides by ep, else
+    #               'replicate'. The GSPMD trainer is unaffected: there
+    #               the layout comes from sharding constraints and XLA
+    #               derives the all-to-alls.
+    moe_ep_dispatch: str = "auto"
     # CausalLM: share the input embedding matrix with the LM head
     # (logits = h @ E^T) — halves the vocab-sized params.
     tie_embeddings: bool = False
@@ -123,6 +138,32 @@ class MultiHeadAttention(nn.Module):
         )(out)
 
 
+def _gspmd_constraint(x, spec: P):
+    """``with_sharding_constraint`` iff the ambient (set_mesh) mesh has
+    every axis the spec names in GSPMD (non-Manual) mode — i.e. the
+    GSPMD sharded trainer. Inside a shard_map trainer (DP or pipeline)
+    those axes are Manual and the constraint would be meaningless-to-
+    wrong, and under plain apply (inference, tests) there is no mesh at
+    all; both cases fall through to identity."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is None or not am.shape:
+            return x
+        types = dict(zip(am.axis_names, am.axis_types))
+        axes = [
+            a
+            for part in spec
+            if part is not None
+            for a in (part if isinstance(part, tuple) else (part,))
+        ]
+        for ax in axes:
+            if ax not in types or "Manual" in str(types[ax]):
+                return x
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:  # no mesh context / legacy jax — layout hint only
+        return x
+
+
 class MoEFFN(nn.Module):
     """Top-k mixture-of-experts FFN (switch-style at k=1, GShard-style
     gate-weighted combine at k>=2).
@@ -172,6 +213,17 @@ class MoEFFN(nn.Module):
             g -= 1
         n_groups = n // g
         tokens = x.reshape(n_groups, g, d)
+        # GSPMD layout (active only under the sharded trainer's mesh):
+        # routing groups shard over EVERY data axis including ep —
+        # each ep member routes only its share of the groups — and the
+        # constraint on expert_in below (experts over ep) makes XLA
+        # insert the GShard dispatch all-to-all; the constraint on the
+        # combine output reverses it. See the pipeline trainer's
+        # _moe_ffn_ep_a2a for the same layout written as explicit
+        # collectives.
+        _groups_spec = P(BATCH_AXES + (AXIS_EP,), None, None)
+        _experts_spec = P(BATCH_AXES, AXIS_EP, None, None)
+        tokens = _gspmd_constraint(tokens, _groups_spec)
         # Static per-group capacity: ceil(cf * g * k / e) — scales with
         # the routing fan-out so k=2 doesn't halve effective capacity.
         cap = max(1, math.ceil(cfg.capacity_factor * g * k / e))
@@ -214,6 +266,7 @@ class MoEFFN(nn.Module):
         dispatch = jnp.any(disp, axis=2).astype(dt)  # (G, g, e, cap)
         expert_in = jnp.einsum("gnec,gnd->gecd", dispatch,
                                tokens.astype(dt))    # (G, e, cap, d)
+        expert_in = _gspmd_constraint(expert_in, _experts_spec)  # <- a2a
         w_in = self.param("moe_w_in", nn.initializers.lecun_normal(),
                           (e, d, cfg.d_ff))
         b_in = self.param("moe_b_in", nn.initializers.zeros, (e, cfg.d_ff))
@@ -222,13 +275,16 @@ class MoEFFN(nn.Module):
         b_out = self.param("moe_b_out", nn.initializers.zeros, (e, d))
         h = jnp.einsum("gecd,edf->gecf", expert_in, w_in.astype(dt))
         h = nn.gelu(h + b_in[None, :, None].astype(dt))
+        h = _gspmd_constraint(h, _experts_spec)
         expert_out = jnp.einsum("gecf,efd->gecd", h, w_out.astype(dt))
         expert_out = expert_out + b_out[None, :, None].astype(dt)
+        expert_out = _gspmd_constraint(expert_out, _experts_spec)
 
         # Gate-weighted combine over the kept (token, choice) slots.
         combine = jnp.einsum("gnk,gnkec->gnec", gates.astype(dt),
                              disp.astype(dt))        # (G, g, e, cap)
         out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
+        out = _gspmd_constraint(out, _groups_spec)   # <- combine a2a back
 
         # Switch load-balance loss over VALID tokens only: e * sum_e
         # frac_e * prob_e, where frac uses the primary (first) choice.

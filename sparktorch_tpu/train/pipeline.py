@@ -50,6 +50,7 @@ that:
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import flax.linen as nn
@@ -168,6 +169,79 @@ def _ep_reduce_bwd(_, ct):
 _ep_reduce.defvjp(_ep_reduce_fwd, _ep_reduce_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _a2a_ep(x, split_axis: int, concat_axis: int):
+    """Tiled all_to_all over ``ep`` with an explicit reverse-exchange
+    backward. The op is linear, so its true VJP is the inverse
+    exchange (swap split/concat axes); spelling it as a custom_vjp
+    keeps the pp schedules' autodiff (GPipe's grad-through-scan and
+    1F1B's per-tick ``jax.vjp``) off jax's all_to_all transpose path,
+    which miscompiles for split != concat (verified on jax 0.9)."""
+    return jax.lax.all_to_all(x, AXIS_EP, split_axis, concat_axis,
+                              tiled=True)
+
+
+def _a2a_ep_fwd(x, split_axis, concat_axis):
+    return _a2a_ep(x, split_axis, concat_axis), None
+
+
+def _a2a_ep_bwd(split_axis, concat_axis, _, ct):
+    return (jax.lax.all_to_all(ct, AXIS_EP, concat_axis, split_axis,
+                               tiled=True),)
+
+
+_a2a_ep.defvjp(_a2a_ep_fwd, _a2a_ep_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ep_scatter(x, g_loc: int):
+    """This member's block of ``g_loc`` leading-dim entries of an
+    ep-REPLICATED array (block m for ep member m). Backward:
+    all_gather of the per-member cotangent blocks — the assembled
+    cotangent is complete and identical on every member, so gradients
+    upstream of the scatter stay ep-replicated (each block counted
+    exactly once; a transpose-of-slice alone would leave per-member
+    partial cotangents)."""
+    i = jax.lax.axis_index(AXIS_EP)
+    return jax.lax.dynamic_slice_in_dim(x, i * g_loc, g_loc, 0)
+
+
+def _ep_scatter_fwd(x, g_loc):
+    return _ep_scatter(x, g_loc), None
+
+
+def _ep_scatter_bwd(g_loc, _, ct):
+    return (jax.lax.all_gather(ct, AXIS_EP, axis=0, tiled=True),)
+
+
+_ep_scatter.defvjp(_ep_scatter_fwd, _ep_scatter_bwd)
+
+
+@jax.custom_vjp
+def _ep_gather(x):
+    """Inverse of :func:`_ep_scatter`: all_gather the members' blocks
+    into the full ep-replicated array. Backward: each member keeps its
+    OWN block of the incoming cotangent — not a reduce_scatter: the
+    downstream computation is ep-replicated, so every member already
+    holds the full cotangent and summing over members would scale it
+    by ep (the same trap the psum/psum pair guards)."""
+    return jax.lax.all_gather(x, AXIS_EP, axis=0, tiled=True)
+
+
+def _ep_gather_fwd(x):
+    return _ep_gather(x), None
+
+
+def _ep_gather_bwd(_, ct):
+    n_ep = jax.lax.axis_size(AXIS_EP)
+    g_loc = ct.shape[0] // n_ep
+    i = jax.lax.axis_index(AXIS_EP)
+    return (jax.lax.dynamic_slice_in_dim(ct, i * g_loc, g_loc, 0),)
+
+
+_ep_gather.defvjp(_ep_gather_fwd, _ep_gather_bwd)
+
+
 # ---------------------------------------------------------------------------
 # Stage math (EncoderLayer's exact param tree, explicit einsum form)
 # ---------------------------------------------------------------------------
@@ -247,47 +321,27 @@ class _AttnPart(nn.Module):
         return x, h
 
 
-def _moe_ffn_ep(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
-    """Expert-parallel MoE FFN inside the pp shard_map: the exact math
-    of :class:`models.transformer.MoEFFN` in explicit form, with the
-    expert dimension SHARDED over the ``ep`` mesh axis.
-
-    Layout: tokens are replicated across ep members (the batch shards
-    over dp only), the router is replicated so every member computes
-    identical routing, and each member applies only its local slice of
-    experts — one psum over ``ep`` combines the partial outputs. No
-    all-to-all is needed in this layout: what GSPMD derives from
-    operand shardings in the sharded trainer becomes a single combine
-    reduction here. Returns (out, aux_loss, dropped, routed) — the
-    same observables MoEFFN sows.
-
-    ``mp`` is the LOCAL moe param subtree: expert leaves arrive
-    pre-sliced to ``e_loc = n_experts/ep`` by shard_map; router params
-    replicated."""
-    import math
-
-    dt = cfg.compute_dtype
-    b, s, d = h.shape
-    e = cfg.n_experts
-    e_loc = e // n_ep
-    k = max(1, min(cfg.moe_top_k, e))
-    n = b * s
+def _moe_groups(cfg: TransformerConfig, n: int) -> Tuple[int, int]:
+    """(group size, group count): largest g <= moe_group_size dividing
+    the token count (trace-time ints; shared by every MoE path)."""
     g = min(n, max(1, cfg.moe_group_size))
     while n % g:
         g -= 1
-    n_groups = n // g
-    tokens = h.reshape(n_groups, g, d)
-    if n_ep > 1:
-        # Identity forward / psum-over-ep backward: the ONLY consumer
-        # of `tokens` is the expert path (router + dispatch), whose
-        # per-member input-cotangents are partial (one expert slice
-        # each) — _ep_enter completes them so upstream grads stay
-        # ep-replicated.
-        tokens = _ep_enter(tokens)
-    cap = max(1, math.ceil(cfg.capacity_factor * g * k / e))
-    mask = (token_w.reshape(n_groups, g) > 0) if token_w is not None else None
+    return g, n // g
 
-    # Router in f32, replicated across ep: identical routing everywhere.
+
+def _moe_route(cfg: TransformerConfig, mp, tokens, mask, cap: int):
+    """Router + GShard capacity assignment for a block of routing
+    groups — the exact routing math of
+    :class:`models.transformer.MoEFFN`, factored so the replicated and
+    all-to-all ep layouts share one definition (routing is per-group,
+    so it is layout-independent). ``tokens``: (G, g, d). Returns
+    ``(probs, oh, gates, disp, keep)`` with ``disp`` the
+    (G, g, k, e, cap) choice-level dispatch plan."""
+    e = cfg.n_experts
+    k = max(1, min(cfg.moe_top_k, e))
+    n_groups, g, _ = tokens.shape
+    # Router in f32 (small matmul; numerics matter more than MXU).
     logits = (
         tokens.astype(jnp.float32) @ mp["router"]["kernel"]
         + mp["router"]["bias"]
@@ -304,14 +358,73 @@ def _moe_ffn_ep(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
     if mask is not None:
         oh = oh * mask[:, :, None, None]
         gates = gates * mask[:, :, None]
-    # Choice-major capacity priority (GShard), as in MoEFFN.
+    # Choice-major capacity priority (GShard): ALL first choices rank
+    # before any second choice.
     oh_t = oh.transpose(0, 2, 1, 3).reshape(n_groups, k * g, e)
     pos = jnp.cumsum(oh_t, axis=1) * oh_t
     keep = (pos > 0) & (pos <= cap)
     slot = jnp.clip(pos - 1, 0, cap - 1)
     disp_flat = keep[..., None] & jax.nn.one_hot(slot, cap, dtype=bool)
     disp = disp_flat.reshape(n_groups, k, g, e, cap).transpose(0, 2, 1, 3, 4)
+    return probs, oh, gates, disp, keep
 
+
+def _moe_aux_counts(cfg: TransformerConfig, probs, oh, keep, mask):
+    """Load-balance + observability sums over THIS block of groups:
+    ``(term, dropped, routed)`` where ``term`` = sum over the block's
+    groups of sum_e frac_e*mean_prob_e (the caller normalizes by the
+    GLOBAL group count and applies moe_aux_weight * e)."""
+    oh0 = oh[:, :, 0, :].astype(jnp.float32)
+    if mask is not None:
+        mf = mask.astype(jnp.float32)
+        valid = jnp.maximum(jnp.sum(mf, axis=1), 1.0)
+        frac = jnp.sum(oh0, axis=1) / valid[:, None]
+        mean_prob = jnp.sum(probs * mf[:, :, None], axis=1) / valid[:, None]
+    else:
+        frac = jnp.mean(oh0, axis=1)
+        mean_prob = jnp.mean(probs, axis=1)
+    term = jnp.sum(frac * mean_prob)
+    routed = jnp.sum(oh).astype(jnp.float32)
+    kept = jnp.sum(keep.astype(jnp.float32))
+    return term, routed - kept, routed
+
+
+def _moe_ffn_ep(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
+    """Replicated-token expert-parallel MoE FFN inside the pp
+    shard_map: tokens replicate across ep members (the batch shards
+    over dp only), the router is replicated so every member computes
+    identical routing, and each member applies only its local slice of
+    experts — one psum over ``ep`` combines the partial outputs.
+    Correct at any ep, but per-member routing work and activation
+    bytes do NOT shrink with ep — :func:`_moe_ffn_ep_a2a` is the
+    scaling layout; this one remains for group counts that don't
+    divide by ep (and as the parity reference). Returns
+    (out, aux_loss, dropped, routed) — the observables MoEFFN sows.
+
+    ``mp`` is the LOCAL moe param subtree: expert leaves arrive
+    pre-sliced to ``e_loc = n_experts/ep`` by shard_map; router params
+    replicated."""
+    import math
+
+    dt = cfg.compute_dtype
+    b, s, d = h.shape
+    e = cfg.n_experts
+    e_loc = e // n_ep
+    k = max(1, min(cfg.moe_top_k, e))
+    n = b * s
+    g, n_groups = _moe_groups(cfg, n)
+    tokens = h.reshape(n_groups, g, d)
+    if n_ep > 1:
+        # Identity forward / psum-over-ep backward: the ONLY consumer
+        # of `tokens` is the expert path (router + dispatch), whose
+        # per-member input-cotangents are partial (one expert slice
+        # each) — _ep_enter completes them so upstream grads stay
+        # ep-replicated.
+        tokens = _ep_enter(tokens)
+    cap = max(1, math.ceil(cfg.capacity_factor * g * k / e))
+    mask = (token_w.reshape(n_groups, g) > 0) if token_w is not None else None
+
+    probs, oh, gates, disp, keep = _moe_route(cfg, mp, tokens, mask, cap)
     dispatch = jnp.any(disp, axis=2).astype(dt)  # (G, g, e, cap)
     combine = jnp.einsum("gnk,gnkec->gnec", gates.astype(dt),
                          disp.astype(dt))        # (G, g, e, cap)
@@ -334,19 +447,10 @@ def _moe_ffn_ep(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
         # identity backward, so the output cotangent isn't re-summed).
         out = _ep_reduce(out)
 
-    # Switch load-balance aux + drop counts over valid tokens, exactly
-    # as MoEFFN sows them (replicated across ep — computed from the
-    # replicated routing, so no reduction needed).
-    oh0 = oh[:, :, 0, :].astype(jnp.float32)
-    if mask is not None:
-        mf = mask.astype(jnp.float32)
-        valid = jnp.maximum(jnp.sum(mf, axis=1), 1.0)
-        frac = jnp.sum(oh0, axis=1) / valid[:, None]
-        mean_prob = jnp.sum(probs * mf[:, :, None], axis=1) / valid[:, None]
-    else:
-        frac = jnp.mean(oh0, axis=1)
-        mean_prob = jnp.mean(probs, axis=1)
-    aux = cfg.moe_aux_weight * e * jnp.mean(jnp.sum(frac * mean_prob, -1))
+    # Aux + drop counts from the (replicated) routing — already global
+    # per (pp, dp) shard, no ep reduction.
+    term, dropped, routed = _moe_aux_counts(cfg, probs, oh, keep, mask)
+    aux = cfg.moe_aux_weight * e * term / n_groups
     if n_ep > 1:
         # The aux VALUE is replicated across ep (computed from the
         # replicated routing), but its router gradient is computed in
@@ -354,9 +458,101 @@ def _moe_ffn_ep(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
         # a per-member share. Scale the aux GRADIENT by 1/ep (value
         # unchanged) so the (dp, ep) psum of router grads is exact.
         aux = aux / n_ep + jax.lax.stop_gradient(aux * (1.0 - 1.0 / n_ep))
-    routed = jnp.sum(oh).astype(jnp.float32)
-    kept = jnp.sum(keep.astype(jnp.float32))
-    return out.reshape(b, s, d), aux, routed - kept, routed
+    return out.reshape(b, s, d), aux, dropped, routed
+
+
+def _moe_ffn_ep_a2a(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
+    """GShard-style expert-parallel MoE FFN inside the pp shard_map:
+    token blocks travel to their experts' owners over an explicit
+    ``all_to_all`` (and back), so — unlike the replicated layout —
+    per-member routing/dispatch work and activation bytes scale 1/ep.
+
+    Layout (the explicit-collective twin of the sharding-constraint
+    layout in ``models.transformer.MoEFFN``):
+
+    1. each ep member takes its 1/ep block of the routing GROUPS
+       (:func:`_ep_scatter`; groups route independently, so routing
+       decisions are bit-identical to ep=1),
+    2. routes only those groups and builds its (G_loc, e, cap)
+       dispatch plan + (G_loc, e, cap, d) expert inputs,
+    3. ``all_to_all``: expert blocks swap for group blocks — each
+       member now holds (G, e_loc, cap, d), every group's capacity
+       slots for ITS experts,
+    4. local expert FFN, reverse ``all_to_all``, gate-weighted combine
+       of its own groups,
+    5. :func:`_ep_gather` restores the ep-replicated (b, s, d) layout
+       the surrounding (attention/residual) stage math expects.
+
+    Requires ``n_groups % ep == 0`` (the dispatcher falls back to the
+    replicated layout otherwise). Same return contract as
+    :func:`_moe_ffn_ep`; exactness against it is pinned by
+    ``test_pp_ep_a2a_parity``."""
+    import math
+
+    dt = cfg.compute_dtype
+    b, s, d = h.shape
+    e = cfg.n_experts
+    k = max(1, min(cfg.moe_top_k, e))
+    n = b * s
+    g, n_groups = _moe_groups(cfg, n)
+    g_loc = n_groups // n_ep
+    cap = max(1, math.ceil(cfg.capacity_factor * g * k / e))
+
+    tokens = _ep_scatter(h.reshape(n_groups, g, d), g_loc)  # (G_loc, g, d)
+    if token_w is not None:
+        i = jax.lax.axis_index(AXIS_EP)
+        mask = jax.lax.dynamic_slice_in_dim(
+            token_w.reshape(n_groups, g) > 0, i * g_loc, g_loc, 0
+        )
+    else:
+        mask = None
+
+    probs, oh, gates, disp, keep = _moe_route(cfg, mp, tokens, mask, cap)
+    dispatch = jnp.any(disp, axis=2).astype(dt)      # (G_loc, g, e, cap)
+    combine = jnp.einsum("gnk,gnkec->gnec", gates.astype(dt),
+                         disp.astype(dt))            # (G_loc, g, e, cap)
+
+    expert_in = jnp.einsum("gnec,gnd->gecd", dispatch,
+                           tokens.astype(dt))        # (G_loc, e, cap, d)
+    expert_in = _a2a_ep(expert_in, 1, 0)             # (G, e_loc, cap, d)
+    hmid = jnp.einsum("gecd,edf->gecf", expert_in, mp["moe_w_in"].astype(dt))
+    hmid = nn.gelu(hmid + mp["moe_b_in"][None, :, None].astype(dt))
+    expert_out = jnp.einsum("gecf,efd->gecd", hmid,
+                            mp["moe_w_out"].astype(dt))
+    expert_out = expert_out + mp["moe_b_out"][None, :, None].astype(dt)
+    back = _a2a_ep(expert_out, 0, 1)                 # (G_loc, e, cap, d)
+    out_loc = jnp.einsum("gnec,gecd->gnd", combine, back)  # (G_loc, g, d)
+    out = _ep_gather(out_loc).reshape(b, s, d)
+
+    # Per-member partial sums over its OWN groups; the aux value uses
+    # _ep_reduce (psum forward, identity backward) so each member's
+    # router gradient stays its true per-group share — the (dp, ep)
+    # psum in the trainer's grad reduction completes it. Drop counts
+    # are metrics (never differentiated): a plain psum globalizes them.
+    term, dropped, routed = _moe_aux_counts(cfg, probs, oh, keep, mask)
+    aux = cfg.moe_aux_weight * e * _ep_reduce(term) / n_groups
+    dropped = jax.lax.psum(dropped, AXIS_EP)
+    routed = jax.lax.psum(routed, AXIS_EP)
+    return out, aux, dropped, routed
+
+
+def _moe_ffn_ep_dispatch(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
+    """Pick the ep layout per ``cfg.moe_ep_dispatch`` ('a2a' /
+    'replicate' / 'auto'; trace-time decision — shapes are static)."""
+    mode = cfg.moe_ep_dispatch
+    if mode not in ("auto", "a2a", "replicate"):
+        raise ValueError(f"unknown moe_ep_dispatch {mode!r}")
+    _, n_groups = _moe_groups(cfg, h.shape[0] * h.shape[1])
+    divisible = n_groups % n_ep == 0
+    if mode == "a2a" and not divisible:
+        raise ValueError(
+            f"moe_ep_dispatch='a2a' needs the routing group count "
+            f"({n_groups}) divisible by ep={n_ep}; lower moe_group_size "
+            "or use 'auto'"
+        )
+    if n_ep > 1 and divisible and mode in ("auto", "a2a"):
+        return _moe_ffn_ep_a2a(cfg, mp, h, token_w, n_ep)
+    return _moe_ffn_ep(cfg, mp, h, token_w, n_ep)
 
 
 def _stacked_layer_init(cfg, key, use_moe: bool, n: int):
@@ -637,7 +833,7 @@ def make_pp_train_step(
                                 for k in ("ln_attn", "attn", "ln_mlp")}},
                     h,
                 )
-                moe_out, aux, dropped, routed = _moe_ffn_ep(
+                moe_out, aux, dropped, routed = _moe_ffn_ep_dispatch(
                     cfg, lp["moe"], h_ln, token_w, E
                 )
                 return x_mid + moe_out, aux, dropped, routed

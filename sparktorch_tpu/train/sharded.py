@@ -212,6 +212,10 @@ def make_sharded_train_step(
         with jax.set_mesh(mesh):
             return jitted(state, batch)
 
+    # Introspection hooks (tests assert on the compiled HLO — e.g. that
+    # the MoE layout constraints actually lower to all-to-alls).
+    run.jitted = jitted
+    run.mesh = mesh
     return run
 
 
