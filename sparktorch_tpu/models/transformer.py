@@ -118,7 +118,7 @@ class MultiHeadAttention(nn.Module):
             from sparktorch_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, cfg.causal)
-        elif cfg.attn_impl == "ring":
+        elif cfg.attn_impl == "ring" and _sp_mesh_available():
             from sparktorch_tpu.train.step import shard_map_compat
 
             spec = P(BATCH_AXES, "sp", "tp", None)
@@ -132,10 +132,30 @@ class MultiHeadAttention(nn.Module):
             )
             out = attn(q, k, v)
         else:
+            # dense — also the ring fallback when no GSPMD mesh with
+            # sp>1 is ambient (plain init/apply, inference transforms,
+            # manual-axis trainers): ring IS dense attention computed
+            # blockwise, so a ring-trained model applies anywhere.
             out = dense_attention(q, k, v, causal=cfg.causal)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=dt, name="proj"
         )(out)
+
+
+def _sp_mesh_available() -> bool:
+    """Whether a GSPMD (non-Manual) ambient mesh with sp > 1 is in
+    scope — the only context where the ring-attention shard_map island
+    can (and should) open. Everywhere else — plain init/apply with no
+    mesh, or inside a shard_map trainer where axes are Manual — ring
+    falls back to dense (same math, single block)."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is None or "sp" not in am.shape or am.shape["sp"] <= 1:
+            return False
+        types = dict(zip(am.axis_names, am.axis_types))
+        return "Manual" not in str(types["sp"])
+    except Exception:
+        return False
 
 
 def _gspmd_constraint(x, spec: P):
