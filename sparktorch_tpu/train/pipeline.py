@@ -621,6 +621,109 @@ def _moe_ffn_ep_dispatch(cfg: TransformerConfig, mp, h, token_w, n_ep: int):
     return _moe_ffn_ep(cfg, mp, h, token_w, n_ep)
 
 
+def interleave_stack_permutation(n_layers: int, S: int, V: int) -> np.ndarray:
+    """Global layer order for the INTERLEAVED pipeline layout: virtual
+    stage j = v*S + d (v-th chunk on device d) covers global layers
+    [j*lps, (j+1)*lps), and the pp sharding splits the stacked layer
+    dim into S contiguous device blocks — so device d's block must
+    hold its V chunks in chunk order. Apply to the stacked tree before
+    :func:`place_pipeline_state` (``a[perm]``); invert with
+    ``np.argsort(perm)`` after training. V=1 is the identity."""
+    if n_layers % (S * V) != 0:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pp*virtual_stages="
+            f"{S * V}"
+        )
+    lps = n_layers // (S * V)
+    order = []
+    for d in range(S):
+        for v in range(V):
+            j = v * S + d
+            order.extend(range(j * lps, (j + 1) * lps))
+    return np.asarray(order)
+
+
+def _interleaved_schedule(S: int, V: int, M: int):
+    """Host-side static schedule for interleaved 1F1B on a global
+    combined-tick clock. Microbatches advance in groups of S per chunk
+    (the Megatron ordering), giving closed-form tick times:
+
+      fwd  of stage j=v*S+d, microbatch m=g*S+r:
+          t = g*V*S + v*S + r + d
+      bwd (mirrored), offset D = V*S - 1:
+          t = D + g*V*S + (V-1-v)*S + r + (S-1-d)
+
+    Every consecutive virtual stage runs EXACTLY one tick later, so
+    the single +1-ring ppermute per tick delivers each activation the
+    tick it is consumed — no receive buffering. Total ticks
+    T = V*M + V*S + S - 2 (V=1 recovers the plain 1F1B's M + 2S - 2);
+    per tick each device does ONE chunk fwd + ONE chunk bwd (1/V of a
+    full stage), so the warmup/drain bubble shrinks ~V-fold relative
+    to plain 1F1B at equal per-tick width.
+
+    Returns ``(T, fwd_v, fwd_m, bwd_v, bwd_m)`` with (T, S) int32
+    tables, -1 marking an idle sub-tick."""
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs n_micro ({M}) divisible by pp ({S})"
+        )
+    D = V * S - 1
+    T = V * M + V * S + S - 2
+    fwd_v = -np.ones((T, S), np.int32)
+    fwd_m = -np.ones((T, S), np.int32)
+    bwd_v = -np.ones((T, S), np.int32)
+    bwd_m = -np.ones((T, S), np.int32)
+    for d in range(S):
+        for g in range(M // S):
+            for v in range(V):
+                for r in range(S):
+                    m = g * S + r
+                    tf = g * V * S + v * S + r + d
+                    tb = D + g * V * S + (V - 1 - v) * S + r + (S - 1 - d)
+                    assert fwd_v[tf, d] < 0 and bwd_v[tb, d] < 0, "collision"
+                    fwd_v[tf, d] = v
+                    fwd_m[tf, d] = m
+                    bwd_v[tb, d] = v
+                    bwd_m[tb, d] = m
+    return T, fwd_v, fwd_m, bwd_v, bwd_m
+
+
+def _interleaved_ring_slots(S: int, V: int, M: int) -> int:
+    """Smallest ring size RV such that slot ``m % RV`` is collision-
+    free among in-flight microbatches of any one chunk (checked
+    exactly against the schedule's [t_fwd, t_bwd] lifetimes)."""
+    T, fwd_v, fwd_m, bwd_v, bwd_m = _interleaved_schedule(S, V, M)
+    # Lifetimes grouped by (device, chunk) — only same-chunk
+    # microbatches can collide on a slot.
+    groups: dict = {}
+    for d in range(S):
+        for t in range(T):
+            if fwd_v[t, d] >= 0:
+                groups.setdefault((d, int(fwd_v[t, d])), {})[
+                    int(fwd_m[t, d])
+                ] = [t, None]
+            if bwd_v[t, d] >= 0:
+                groups[(d, int(bwd_v[t, d]))][int(bwd_m[t, d])][1] = t
+    for RV in range(1, 3 * S + 2):
+        ok = True
+        for life in groups.values():
+            for m, (t0, t1) in life.items():
+                # Only later microbatches sharing the slot can overlap.
+                m2 = m + RV
+                while ok and m2 in life:
+                    u0, u1 = life[m2]
+                    if not (t1 < u0 or u1 < t0):
+                        ok = False
+                    m2 += RV
+                if not ok:
+                    break
+            if not ok:
+                break
+        if ok:
+            return RV
+    return M  # fallback: one slot per microbatch
+
+
 def _stacked_layer_init(cfg, key, use_moe: bool, n: int):
     if cfg.attn_impl == "ring":
         # The attention impl never changes the param tree; the flax
@@ -794,6 +897,7 @@ def make_pp_train_step(
     mini_batch: Optional[int] = None,
     steps_per_call: int = 1,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> Callable[[PipelineState, DataBatch], Tuple[PipelineState, jax.Array]]:
     """Build the jitted pipelined train step over ``mesh`` (dp x pp x
     tp x sp x ep; other axes must be 1 for this trainer). sp > 1
@@ -840,6 +944,27 @@ def make_pp_train_step(
             "over sp, so attn_impl must be 'ring' (dense/flash only see "
             "the local block)"
         )
+    V = max(1, int(virtual_stages))
+    if V > 1:
+        # Interleaved 1F1B: V chunks per device, chunk-granular ticks
+        # (the layer stack must be pre-permuted with
+        # interleave_stack_permutation so device d's pp shard holds
+        # stages {d, S+d, ...}).
+        if schedule != "1f1b":
+            raise ValueError(
+                "virtual_stages>1 is the interleaved 1F1B schedule; "
+                "set schedule='1f1b'"
+            )
+        if cfg.n_layers % (S * V) != 0:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by pp*virtual_"
+                f"stages={S * V}"
+            )
+        if n_micro % S != 0:
+            raise ValueError(
+                f"interleaved 1F1B needs n_micro ({n_micro}) divisible "
+                f"by pp ({S})"
+            )
     if cfg.n_layers % max(1, S) != 0:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={S}")
     if cfg.n_heads % max(1, T) != 0:
@@ -852,6 +977,12 @@ def make_pp_train_step(
     # stays the GSPMD trainer's ep axis.
     pattern = _moe_pattern(cfg)
     has_moe = any(pattern)
+    if V > 1 and (has_moe or SP > 1):
+        raise ValueError(
+            "virtual_stages>1 (interleaved 1F1B) currently supports "
+            "dense stacks with sp=1 (tp composes); MoE and sp "
+            "compose with the plain schedules"
+        )
     if E > 1 and not has_moe:
         raise ValueError(
             "mesh ep>1 needs MoE layers (n_experts>0) — there are no "
@@ -1409,6 +1540,160 @@ def make_pp_train_step(
         grads = jax.tree.map(lambda g: g / den_safe, grads)
         return loss, den_g, grads, drop_fraction
 
+    if V > 1:
+        T_ticks, _fv, _fm, _bv, _bm = _interleaved_schedule(S, V, n_micro)
+        RV = _interleaved_ring_slots(S, V, n_micro)
+        fv_tab, fm_tab = jnp.asarray(_fv), jnp.asarray(_fm)
+        bv_tab, bm_tab = jnp.asarray(_bv), jnp.asarray(_bm)
+        lps_i = cfg.n_layers // (S * V)
+
+    def interleaved_grads(params, x, y, w):
+        """Interleaved (virtual-stage) 1F1B: each device owns V chunks
+        of lps = L/(S*V) layers (virtual stage j = v*S + d), and each
+        combined tick runs ONE chunk forward + ONE chunk backward per
+        the static ``_interleaved_schedule`` tables — 1/V of a plain
+        1F1B tick's width, so the warmup/drain bubble shrinks ~V-fold:
+        T = V*M + V*S + S - 2 chunk-ticks of (1 fwd + 1 recompute-bwd)
+        chunk vs plain 1F1B's (M + 2S - 2) ticks of V-chunk width.
+        Stage inputs persist in a (V, RV) ring (RV from the schedule's
+        exact in-flight lifetimes): activation memory stays O(V*S),
+        independent of M. Same gradient math as the other schedules
+        (exactness-tested); the layer stack must be in the
+        ``interleave_stack_permutation`` order."""
+        stage = jax.lax.axis_index(AXIS_PP)
+        b_local, s_len = x.shape
+        if b_local % n_micro != 0:
+            raise ValueError(
+                f"local batch {b_local} not divisible by n_micro={n_micro}"
+            )
+        mb = b_local // n_micro
+        micro_x = x.reshape(n_micro, mb, s_len)
+        micro_y = y.reshape((n_micro, mb) + y.shape[1:])
+        micro_w = w.reshape(n_micro, mb)
+        M = n_micro
+        fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+        bwd_ring = [(i, (i - 1) % S) for i in range(S)]
+        den_g = jax.lax.psum(jnp.sum(w), AXIS_DP)
+        den_safe = jnp.maximum(den_g, 1.0)
+
+        def chunk_params(p, v):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, v * lps_i, lps_i, 0
+                ),
+                p["layers"],
+            )
+
+        def chunk_outs(p, h_in, v, mi):
+            """One chunk's forward + (final-virtual-stage-only) head
+            num — the differentiable unit of the interleaved tick.
+            The dynamic chunk slice transposes to a dynamic-update
+            into zeros, so each backward lands its gradient on the
+            right chunk rows."""
+            h_out = stage_fn(chunk_params(p, v), h_in)
+            num = jax.lax.cond(
+                (v == V - 1) & (stage == S - 1),
+                lambda: head_loss(p, h_out, micro_y[mi], micro_w[mi])[0],
+                lambda: jnp.zeros(()),
+            )
+            return h_out, num
+
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            ring, fwd_ch, bwd_ch, grads, num = carry
+
+            vf = fv_tab[t, stage]
+            mf = fm_tab[t, stage]
+            fwd_valid = vf >= 0
+            vf_c = jnp.clip(vf, 0, V - 1)
+            mf_c = jnp.clip(mf, 0, M - 1)
+
+            def do_fwd():
+                h_in = jax.lax.cond(
+                    (vf_c == 0) & (stage == 0),
+                    lambda: embed(params, micro_x[mf_c]),
+                    lambda: fwd_ch,
+                )
+                h_out, n_ = chunk_outs(params, h_in, vf_c, mf_c)
+                return h_in, h_out, n_
+
+            def skip_fwd():
+                z = jnp.zeros((mb, s_len, cfg.d_model), dt)
+                return z, z, jnp.zeros(())
+
+            h_in, h_out, n_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
+            num = num + n_
+            ring = jnp.where(
+                fwd_valid,
+                jax.lax.dynamic_update_slice(
+                    ring, h_in[None, None], (vf_c, mf_c % RV, 0, 0, 0)
+                ),
+                ring,
+            )
+
+            vb = bv_tab[t, stage]
+            mb_i = bm_tab[t, stage]
+            bwd_valid = vb >= 0
+            vb_c = jnp.clip(vb, 0, V - 1)
+            mb_c = jnp.clip(mb_i, 0, M - 1)
+
+            def do_bwd():
+                h_saved = jax.lax.dynamic_slice(
+                    ring, (vb_c, mb_c % RV, 0, 0, 0),
+                    (1, 1, mb, s_len, cfg.d_model),
+                )[0, 0]
+                is_last = (vb_c == V - 1) & (stage == S - 1)
+                _, pull = jax.vjp(
+                    lambda p, h: chunk_outs(p, h, vb_c, mb_c),
+                    params, h_saved,
+                )
+                # Last virtual stage: h_out ct comes only through its
+                # own head term; elsewhere seed with the backward-ring
+                # ct (the num seed is harmless off the last stage —
+                # that branch is the zero function there).
+                seed_h = jnp.where(is_last, 0.0, 1.0).astype(dt) * bwd_ch
+                ct_params, ct_h = pull((seed_h, jnp.ones(())))
+
+                def embed_grads():
+                    _, epull = jax.vjp(
+                        lambda p: embed(p, micro_x[mb_c]), params
+                    )
+                    return epull(ct_h)[0]
+
+                ct_params = jax.lax.cond(
+                    (vb_c == 0) & (stage == 0),
+                    lambda: jax.tree.map(jnp.add, ct_params,
+                                         embed_grads()),
+                    lambda: ct_params,
+                )
+                return ct_params, ct_h
+
+            def skip_bwd():
+                return zero_grads, jnp.zeros((mb, s_len, cfg.d_model), dt)
+
+            ct_params, ct_h = jax.lax.cond(bwd_valid, do_bwd, skip_bwd)
+            grads = jax.tree.map(jnp.add, grads, ct_params)
+
+            fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
+            bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
+            return (ring, fwd_next, bwd_next, grads, num), None
+
+        init = (
+            jnp.zeros((V, RV, mb, s_len, cfg.d_model), dt),
+            jnp.zeros((mb, s_len, cfg.d_model), dt),
+            jnp.zeros((mb, s_len, cfg.d_model), dt),
+            zero_grads,
+            jnp.zeros(()),
+        )
+        (_, _, _, grads, num), _ = jax.lax.scan(
+            tick, init, jnp.arange(T_ticks)
+        )
+        num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
+        loss = num_g / den_safe
+        grads = jax.tree.map(lambda g: g / den_safe, grads)
+        return loss, den_g, grads, jnp.zeros(())
+
     def local_step(params, opt_state, x, y, w, key):
         dp_idx = jax.lax.axis_index(AXIS_DP)
 
@@ -1436,7 +1721,11 @@ def make_pp_train_step(
                 )
             else:
                 b = DataBatch(x=x, y=y, w=w)
-            if schedule == "1f1b":
+            if schedule == "1f1b" and V > 1:
+                loss, examples, grads, drop_fraction = interleaved_grads(
+                    params, b.x, b.y, b.w
+                )
+            elif schedule == "1f1b":
                 loss, examples, grads, drop_fraction = one_f_one_b_grads(
                     params, b.x, b.y, b.w
                 )
@@ -1759,6 +2048,7 @@ def train_distributed_pipeline(
     steps_per_call: Optional[int] = None,
     profile_dir: Optional[str] = None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -1886,10 +2176,21 @@ def train_distributed_pipeline(
     step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro, head=head,
                               mini_batch=mini_batch,
                               steps_per_call=steps_per_call,
-                              schedule=schedule)
+                              schedule=schedule,
+                              virtual_stages=virtual_stages)
     rng = jax.random.key(seed)
     flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
     pparams = pipeline_params_from_flax(flax_params, cfg)
+    perm = None
+    if virtual_stages and virtual_stages > 1:
+        # Interleaved layout: re-order the stacked layers so device
+        # d's contiguous pp shard holds its V chunks (undone below so
+        # the returned params are in ordinary flax order).
+        perm = interleave_stack_permutation(
+            cfg.n_layers, mesh.shape[AXIS_PP], virtual_stages
+        )
+        pparams["layers"] = jax.tree.map(lambda a: a[perm],
+                                         pparams["layers"])
     state = place_pipeline_state(pparams, tx, mesh)
 
     from sparktorch_tpu.train.sync import (
@@ -1897,6 +2198,34 @@ def train_distributed_pipeline(
         _open_checkpoint,
         _save_if_due,
     )
+
+    # Checkpointed stacks are stored in the SCHEDULE'S layer order
+    # (interleave-permuted under virtual_stages>1) — a layout marker
+    # makes a mismatched resume fail loudly instead of silently
+    # training a scrambled model.
+    if checkpoint_dir:
+        import json
+        import os
+
+        layout = {
+            "pp": int(mesh.shape[AXIS_PP]),
+            "virtual_stages": int(virtual_stages or 1),
+        }
+        layout_path = os.path.join(checkpoint_dir, "pipeline_layout.json")
+        if resume and os.path.exists(layout_path):
+            with open(layout_path) as f:
+                saved = json.load(f)
+            if saved != layout:
+                raise ValueError(
+                    f"checkpoint layer layout {saved} does not match the "
+                    f"requested {layout}: the stacked layers are stored "
+                    "in the schedule's permuted order — resume with the "
+                    "same pp and virtual_stages"
+                )
+        else:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            with open(layout_path, "w") as f:
+                json.dump(layout, f)
 
     # PipelineState checkpoints like TrainState (step-indexed orbax
     # snapshots restored INTO the pp/tp-sharded layout).
@@ -2014,6 +2343,10 @@ def train_distributed_pipeline(
         _finalize_checkpoint(ckpt, state, completed)
 
     trained = jax.device_get(state.params)
+    if perm is not None:
+        inv = np.argsort(perm)
+        trained["layers"] = jax.tree.map(lambda a: a[inv],
+                                         trained["layers"])
     out_params = flax_params_from_pipeline(trained, cfg)
     return TrainResult(params=out_params, model_state={},
                        metrics=recorder.records, spec=spec,

@@ -174,6 +174,7 @@ def train_distributed(
     pre_sharded: bool = False,
     n_micro: int = 4,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
@@ -182,7 +183,10 @@ def train_distributed(
     mini_batch, validation_pct, early_stop_patience. ``world_size`` and
     ``device`` disappear — the mesh defines the world. ``n_micro`` and
     ``pipeline_schedule`` ('gpipe' | '1f1b') apply only when the mesh
-    has pp>1.
+    has pp>1, as does ``virtual_stages`` (>1 = interleaved 1F1B:
+    requires pipeline_schedule='1f1b', n_micro divisible by pp, and a
+    dense sp=1 stack; shrinks the pipeline bubble ~V-fold at O(V*pp)
+    activation memory).
     """
     del device
     spec = deserialize_model(torch_obj)
@@ -217,6 +221,7 @@ def train_distributed(
             steps_per_call=steps_per_call,
             profile_dir=profile_dir,
             schedule=pipeline_schedule,
+            virtual_stages=virtual_stages,
         )
 
     if pre_sharded:

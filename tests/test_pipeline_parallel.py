@@ -1089,6 +1089,168 @@ def test_pp_sp_rejects_bad_configs():
         make_pp_train_step(cfg_moe, optax.adam(1e-2), mesh, n_micro=2)
 
 
+def test_interleaved_schedule_properties():
+    """The static interleaved schedule: V=1 degenerates to the plain
+    combined-tick count M + 2S - 2; every (chunk, microbatch) pair
+    forwards exactly once and backwards exactly once per device; and
+    the tick count follows T = V*M + V*S + S - 2 (the ~V-fold bubble
+    shrink: per tick only 1/V of a stage runs)."""
+    from sparktorch_tpu.train.pipeline import (
+        _interleaved_schedule,
+        interleave_stack_permutation,
+    )
+
+    for S, V, M in [(2, 1, 8), (2, 2, 8), (4, 2, 8), (2, 3, 6)]:
+        T, fv, fm, bv, bm = _interleaved_schedule(S, V, M)
+        assert T == V * M + V * S + S - 2, (S, V, M, T)
+        for d in range(S):
+            f_pairs = sorted(
+                (int(fv[t, d]), int(fm[t, d]))
+                for t in range(T) if fv[t, d] >= 0
+            )
+            b_pairs = sorted(
+                (int(bv[t, d]), int(bm[t, d]))
+                for t in range(T) if bv[t, d] >= 0
+            )
+            want = sorted((v, m) for v in range(V) for m in range(M))
+            assert f_pairs == want and b_pairs == want, (S, V, M, d)
+
+    # Permutation: V=1 identity; V>1 a true permutation.
+    assert list(interleave_stack_permutation(4, 2, 1)) == [0, 1, 2, 3]
+    p = interleave_stack_permutation(8, 2, 2)
+    assert sorted(p) == list(range(8))
+    # device 0 holds stages 0 and 2 -> global layers [0,1] and [4,5]
+    assert list(p[:4]) == [0, 1, 4, 5], list(p)
+
+
+def test_interleaved_1f1b_exactness():
+    """Interleaved 1F1B (virtual_stages=2) must reproduce gpipe and
+    plain 1f1b exactly on matched init — same math, finer-grained
+    schedule — through the public trainer (which owns the stack
+    permutation and returns ordinary flax-order params). SGD lr=1
+    param parity catches chunk-slice gradient misplacement that loss
+    curves can't see."""
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.train.pipeline import train_distributed_pipeline
+
+    cfg = _cfg(n_layers=4)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (16, cfg.max_len + 1)).astype(
+        np.int32
+    )
+    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-2})
+    spec_sgd = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                         optimizer="sgd", optimizer_params={"lr": 1.0})
+
+    def run(sched, V, sp, n_devices, iters=4, tp=1):
+        mesh = build_mesh(
+            MeshConfig(dp=n_devices // (2 * tp), pp=2, tp=tp),
+            jax.devices()[:n_devices],
+        )
+        r = train_distributed_pipeline(
+            sp, ids[:, :-1], labels=ids[:, 1:], mesh=mesh, iters=iters,
+            n_micro=4, schedule=sched, virtual_stages=V, seed=0,
+        )
+        return [m["loss"] for m in r.metrics], r.params
+
+    l_g, _ = run("gpipe", 1, spec, 8)
+    l_i, _ = run("1f1b", 2, spec, 8)
+    np.testing.assert_allclose(l_i, l_g, rtol=1e-5)
+
+    _, p_1 = run("1f1b", 1, spec_sgd, 8, iters=1)
+    _, p_i = run("1f1b", 2, spec_sgd, 8, iters=1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4,
+                                                atol=1e-6),
+        p_1, p_i,
+    )
+
+    # Composes with tp.
+    l_it, _ = run("1f1b", 2, spec, 8, tp=2)
+    np.testing.assert_allclose(l_it, l_g, rtol=1e-5)
+
+
+def test_interleaved_1f1b_memory():
+    """Interleaved keeps the 1F1B memory property: activation temps
+    scale with V*S ring slots, not the microbatch count — XLA's
+    memory analysis must stay well under GPipe's at many
+    microbatches."""
+    import optax
+
+    from sparktorch_tpu.train.pipeline import interleave_stack_permutation
+
+    cfg = _cfg(max_len=16, n_layers=4)
+    mesh = build_mesh(MeshConfig(dp=1, pp=2), jax.devices()[:2])
+    n_micro = 16
+    batch = _batch(cfg, b=32)
+
+    def analyzed(sched, V):
+        params = init_pipeline_lm(cfg, jax.random.key(0))
+        if V > 1:
+            perm = interleave_stack_permutation(cfg.n_layers, 2, V)
+            params["layers"] = jax.tree.map(lambda a: a[perm],
+                                            params["layers"])
+        tx = optax.sgd(1e-2)
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=n_micro,
+                                  schedule=sched, virtual_stages=V)
+        mem = step.memory_analysis(state, batch)
+        return int(mem.temp_size_in_bytes)
+
+    t_gpipe = analyzed("gpipe", 1)
+    t_inter = analyzed("1f1b", 2)
+    assert t_inter * 2 <= t_gpipe, (t_inter, t_gpipe)
+
+
+def test_interleaved_validation():
+    import optax
+
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    with pytest.raises(ValueError, match="1f1b"):
+        make_pp_train_step(_cfg(), optax.adam(1e-2), mesh, n_micro=4,
+                           schedule="gpipe", virtual_stages=2)
+    with pytest.raises(ValueError, match="divisible"):
+        make_pp_train_step(_cfg(n_layers=6), optax.adam(1e-2), mesh,
+                           n_micro=4, schedule="1f1b", virtual_stages=2)
+    with pytest.raises(ValueError, match="divisible"):
+        make_pp_train_step(_cfg(), optax.adam(1e-2), mesh, n_micro=3,
+                           schedule="1f1b", virtual_stages=2)
+    cfg_moe = _cfg(n_layers=4, n_experts=4, moe_every=2)
+    with pytest.raises(ValueError, match="virtual"):
+        make_pp_train_step(cfg_moe, optax.adam(1e-2), mesh, n_micro=4,
+                           schedule="1f1b", virtual_stages=2)
+
+
+def test_interleaved_checkpoint_layout_guard(tmp_path):
+    """Checkpoints store the stack in the schedule's permuted order:
+    resuming with a different virtual_stages must fail loudly, not
+    silently restore scrambled layers."""
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.train.pipeline import train_distributed_pipeline
+
+    cfg = _cfg(n_layers=4)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (16, cfg.max_len + 1)).astype(
+        np.int32
+    )
+    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-2})
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    ckpt = str(tmp_path / "ckpt")
+    train_distributed_pipeline(
+        spec, ids[:, :-1], labels=ids[:, 1:], mesh=mesh, iters=2,
+        n_micro=4, schedule="1f1b", virtual_stages=2,
+        checkpoint_dir=ckpt, checkpoint_every=1, seed=0,
+    )
+    with pytest.raises(ValueError, match="layout"):
+        train_distributed_pipeline(
+            spec, ids[:, :-1], labels=ids[:, 1:], mesh=mesh, iters=2,
+            n_micro=4, schedule="1f1b", virtual_stages=1,
+            checkpoint_dir=ckpt, resume=True, seed=0,
+        )
+
+
 def test_moe_ep_dispatch_validation():
     import optax
 
