@@ -688,11 +688,15 @@ def _interleaved_schedule(S: int, V: int, M: int):
     return T, fwd_v, fwd_m, bwd_v, bwd_m
 
 
-def _interleaved_ring_slots(S: int, V: int, M: int) -> int:
+def _interleaved_ring_slots(S: int, V: int, M: int, tables=None) -> int:
     """Smallest ring size RV such that slot ``m % RV`` is collision-
     free among in-flight microbatches of any one chunk (checked
-    exactly against the schedule's [t_fwd, t_bwd] lifetimes)."""
-    T, fwd_v, fwd_m, bwd_v, bwd_m = _interleaved_schedule(S, V, M)
+    exactly against the schedule's [t_fwd, t_bwd] lifetimes).
+    ``tables``: pass the already-computed ``_interleaved_schedule``
+    result to avoid rebuilding it."""
+    T, fwd_v, fwd_m, bwd_v, bwd_m = (
+        tables if tables is not None else _interleaved_schedule(S, V, M)
+    )
     # Lifetimes grouped by (device, chunk) — only same-chunk
     # microbatches can collide on a slot.
     groups: dict = {}
@@ -1542,7 +1546,9 @@ def make_pp_train_step(
 
     if V > 1:
         T_ticks, _fv, _fm, _bv, _bm = _interleaved_schedule(S, V, n_micro)
-        RV = _interleaved_ring_slots(S, V, n_micro)
+        RV = _interleaved_ring_slots(
+            S, V, n_micro, tables=(T_ticks, _fv, _fm, _bv, _bm)
+        )
         fv_tab, fm_tab = jnp.asarray(_fv), jnp.asarray(_fm)
         bv_tab, bm_tab = jnp.asarray(_bv), jnp.asarray(_bm)
         lps_i = cfg.n_layers // (S * V)
@@ -1863,6 +1869,16 @@ def make_pp_train_step(
         grads, reporting the TASK loss (the [1][1] aux slot — sown MoE
         aux objectives are excluded from the validation signal, like
         the DP eval)."""
+        if V > 1:
+            # The eval path is the GPipe schedule, which walks each
+            # device's local stack in stage order — under the
+            # interleaved layout that would evaluate a SCRAMBLED layer
+            # order. Fail loudly until an interleaved eval exists.
+            raise ValueError(
+                "validation/eval is not supported with virtual_stages>1 "
+                "yet; train with validation_pct=0 and no early stopping "
+                "signal, or use virtual_stages=1"
+            )
         eval_mapped = shard_map_compat(
             lambda p, x, y, w: schedule_loss(p, x, y, w)[1][1],
             mesh,
@@ -1883,7 +1899,8 @@ def make_pp_train_step(
                 out_specs=(specs, opt_specs, P(), P(), P(), P()),
             )
             cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
-            cache["eval"] = _build_eval(specs)
+            if V == 1:
+                cache["eval"] = _build_eval(specs)
 
     def memory_analysis(state: PipelineState, batch: DataBatch, key=None):
         """XLA's memory analysis of the compiled train step (temp
@@ -2049,6 +2066,7 @@ def train_distributed_pipeline(
     profile_dir: Optional[str] = None,
     schedule: str = "gpipe",
     virtual_stages: int = 1,
+    pre_sharded: bool = False,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -2085,7 +2103,49 @@ def train_distributed_pipeline(
             f"pp training uses cross entropy; got {spec.loss!r}"
         )
 
-    if isinstance(data, DataBatch):
+    if virtual_stages and virtual_stages > 1 and validation_pct > 0:
+        raise ValueError(
+            "validation_pct is not supported with virtual_stages>1 "
+            "(the eval path would walk the interleave-permuted stack "
+            "in the wrong order); use virtual_stages=1 or "
+            "validation_pct=0"
+        )
+    if pre_sharded:
+        # ``data`` is a globally-sharded DataBatch (multi-host path:
+        # per-process shards assembled by train_distributed_multihost
+        # via make_array_from_process_local_data). No host-side
+        # conversion is possible — or needed: validate shapes, cast on
+        # device (sharding-preserving), and train on it directly.
+        if not isinstance(data, DataBatch):
+            raise ValueError(
+                "pre_sharded pp training expects a DataBatch of global "
+                f"arrays; got {type(data).__name__}"
+            )
+        if validation_pct and validation_pct > 0:
+            raise ValueError(
+                "validation_pct is not supported with pre_sharded pp "
+                "data — split before assembling the global batch"
+            )
+        dp = mesh.shape[AXIS_DP]
+        rows = int(data.x.shape[0])
+        if rows % dp != 0 or (rows // dp) % n_micro != 0:
+            raise ValueError(
+                f"pre_sharded rows ({rows}) must divide dp ({dp}) x "
+                f"n_micro ({n_micro}); pad with weight-0 rows before "
+                "sharding (train_distributed_multihost does this)"
+            )
+        sp_ = dict(mesh.shape).get(AXIS_SP, 1)
+        if sp_ > 1 and int(data.x.shape[1]) % sp_ != 0:
+            raise ValueError(
+                f"sequence length {data.x.shape[1]} not divisible by "
+                f"sp={sp_}"
+            )
+        cast = jax.jit(lambda a: a.astype(jnp.int32))
+        batch = DataBatch(x=cast(data.x), y=cast(data.y), w=data.w)
+        val_batch = None
+        n_rows_padded = rows
+        sample_x = np.zeros((1, int(batch.x.shape[1])), np.int32)
+    elif isinstance(data, DataBatch):
         x = np.asarray(data.x)
         y = np.asarray(data.y)
         w = np.asarray(data.w, dtype=np.float32)
@@ -2102,42 +2162,43 @@ def train_distributed_pipeline(
                 raise ValueError("classifier pp training requires labels")
             x, y = x[:, :-1], x[:, 1:]  # next-token LM on one id matrix
         w = np.ones((x.shape[0],), np.float32)
-    x = x.astype(np.int32)
-    y = y.astype(np.int32)
+    if not pre_sharded:
+        x = x.astype(np.int32)
+        y = y.astype(np.int32)
 
-    sp = dict(mesh.shape).get(AXIS_SP, 1)
-    if sp > 1 and x.shape[1] % sp != 0:
-        raise ValueError(
-            f"sequence length {x.shape[1]} not divisible by sp={sp}"
-        )
+        sp = dict(mesh.shape).get(AXIS_SP, 1)
+        if sp > 1 and x.shape[1] % sp != 0:
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by sp={sp}"
+            )
 
-    from sparktorch_tpu.utils.data import pad_to_multiple
+        from sparktorch_tpu.utils.data import pad_to_multiple
 
-    dp = mesh.shape[AXIS_DP]
-    need = dp * n_micro
+        dp = mesh.shape[AXIS_DP]
+        need = dp * n_micro
 
-    def _pad_batch(bx, by, bw):
-        return pad_to_multiple(
-            DataBatch(x=jnp.asarray(bx), y=jnp.asarray(by),
-                      w=jnp.asarray(bw)),
-            need,
-        )
+        def _pad_batch(bx, by, bw):
+            return pad_to_multiple(
+                DataBatch(x=jnp.asarray(bx), y=jnp.asarray(by),
+                          w=jnp.asarray(bw)),
+                need,
+            )
 
-    val_batch = None
-    if validation_pct and validation_pct > 0:
-        # Split BEFORE padding (the reference's per-worker holdout,
-        # util.py:81-95): a shuffled cut of real rows, keeping any
-        # caller-supplied sample weights.
-        perm0 = np.random.default_rng(seed).permutation(x.shape[0])
-        n_val = max(1, int(x.shape[0] * validation_pct))
-        val_idx, train_idx = perm0[:n_val], perm0[n_val:]
-        if train_idx.size == 0:
-            raise ValueError("validation_pct leaves no training rows")
-        val_batch = _pad_batch(x[val_idx], y[val_idx], w[val_idx])
-        x, y, w = x[train_idx], y[train_idx], w[train_idx]
-    n = int(np.sum(w > 0))
-    batch = _pad_batch(x, y, w)
-    n_rows_padded = int(batch.x.shape[0])
+        val_batch = None
+        if validation_pct and validation_pct > 0:
+            # Split BEFORE padding (the reference's per-worker holdout,
+            # util.py:81-95): a shuffled cut of real rows, keeping any
+            # caller-supplied sample weights.
+            perm0 = np.random.default_rng(seed).permutation(x.shape[0])
+            n_val = max(1, int(x.shape[0] * validation_pct))
+            val_idx, train_idx = perm0[:n_val], perm0[n_val:]
+            if train_idx.size == 0:
+                raise ValueError("validation_pct leaves no training rows")
+            val_batch = _pad_batch(x[val_idx], y[val_idx], w[val_idx])
+            x, y, w = x[train_idx], y[train_idx], w[train_idx]
+        batch = _pad_batch(x, y, w)
+        n_rows_padded = int(batch.x.shape[0])
+        sample_x = x[:1]
 
     if mini_batch is not None and mini_batch > 0:
         per_shard = n_rows_padded // dp
@@ -2179,7 +2240,7 @@ def train_distributed_pipeline(
                               schedule=schedule,
                               virtual_stages=virtual_stages)
     rng = jax.random.key(seed)
-    flax_params = dict(spec.init_params(rng, sample_x=x[:1]))["params"]
+    flax_params = dict(spec.init_params(rng, sample_x=sample_x))["params"]
     pparams = pipeline_params_from_flax(flax_params, cfg)
     perm = None
     if virtual_stages and virtual_stages > 1:
@@ -2222,10 +2283,14 @@ def train_distributed_pipeline(
                     "in the schedule's permuted order — resume with the "
                     "same pp and virtual_stages"
                 )
-        else:
+        elif jax.process_index() == 0:
+            # One writer, atomic rename: concurrent gang processes
+            # sharing a checkpoint dir must never see a torn marker.
             os.makedirs(checkpoint_dir, exist_ok=True)
-            with open(layout_path, "w") as f:
+            tmp = layout_path + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump(layout, f)
+            os.replace(tmp, layout_path)
 
     # PipelineState checkpoints like TrainState (step-indexed orbax
     # snapshots restored INTO the pp/tp-sharded layout).
@@ -2342,7 +2407,21 @@ def train_distributed_pipeline(
         profiler.__exit__(None, None, None)
         _finalize_checkpoint(ckpt, state, completed)
 
-    trained = jax.device_get(state.params)
+    if jax.process_count() > 1:
+        # The pp/tp-sharded stacks span non-addressable devices in a
+        # multi-process world: gather to replicated (one all-gather)
+        # so every host returns the full params — the DP multihost
+        # path's contract.
+        from sparktorch_tpu.parallel.mesh import replicated as _replicated
+
+        gather = jax.jit(
+            lambda p: p,
+            out_shardings=jax.tree.map(lambda _: _replicated(mesh),
+                                       state.params),
+        )
+        trained = jax.device_get(gather(state.params))
+    else:
+        trained = jax.device_get(state.params)
     if perm is not None:
         inv = np.argsort(perm)
         trained["layers"] = jax.tree.map(lambda a: a[inv],

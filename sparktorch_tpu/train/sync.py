@@ -199,10 +199,6 @@ def train_distributed(
         # pp>1 routes to the GPipe trainer (pipeline.py), which trains
         # the spec's CausalLM under the pipelined schedule and returns
         # ordinary flax params.
-        if pre_sharded:
-            # Fail loudly: silently dropping a knob would surprise in
-            # exactly the ways that lose data or training signal.
-            raise ValueError("not supported with pp>1 yet: pre_sharded")
         from sparktorch_tpu.train.pipeline import train_distributed_pipeline
 
         return train_distributed_pipeline(
@@ -222,6 +218,7 @@ def train_distributed(
             profile_dir=profile_dir,
             schedule=pipeline_schedule,
             virtual_stages=virtual_stages,
+            pre_sharded=pre_sharded,
         )
 
     if pre_sharded:
@@ -476,7 +473,12 @@ def train_distributed_multihost(
     mesh = mesh or build_mesh()
     n_proc = jax.process_count()
 
-    local_x = np.asarray(local_x, dtype=np.float32)
+    local_x = np.asarray(local_x)
+    if not np.issubdtype(local_x.dtype, np.integer):
+        # Float features stay the DP trainer's float32; integer inputs
+        # (token ids for the pp/sequence models) keep their dtype so
+        # the pp route can cast them back to int32 on device.
+        local_x = local_x.astype(np.float32)
     if local_x.ndim == 1:
         local_x = local_x.reshape(0, 1) if local_x.size == 0 else local_x[:, None]
     local_y = np.asarray(local_y) if local_y is not None else None
@@ -490,10 +492,21 @@ def train_distributed_multihost(
     _MAX_RANK = 8
     if local_x.ndim - 1 > _MAX_RANK:
         raise ValueError(f"feature rank {local_x.ndim - 1} > {_MAX_RANK}")
-    # Layout: [rows, x_rank, x_dims(8), y_rank, y_dims(8)] — y_rank is
-    # -1 when this host has no labels, so donors can repair BOTH the
-    # feature and label shapes of an empty host.
-    width = 2 + _MAX_RANK + 1 + _MAX_RANK
+    # Layout: [rows, x_rank, x_dims(8), y_rank, y_dims(8), x_dtype,
+    # y_dtype] — y_rank is -1 when this host has no labels, so donors
+    # can repair BOTH the feature and label shapes of an empty host;
+    # the dtype codes let the repair match the donors' dtype too (an
+    # int-token host must not be joined by a float32 empty shard).
+    _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.int8,
+               np.uint8, np.int16, np.uint16]
+
+    def _dtype_code(dt) -> int:
+        for i, d in enumerate(_DTYPES):
+            if np.dtype(dt) == np.dtype(d):
+                return i
+        return 0  # treat anything exotic as float32
+
+    width = 2 + _MAX_RANK + 1 + _MAX_RANK + 2
     shape_vec = np.full((width,), 0, np.int64)
     shape_vec[0] = local_x.shape[0]
     feat = local_x.shape[1:]
@@ -508,6 +521,11 @@ def train_distributed_multihost(
             raise ValueError(f"label rank {len(y_feat)} > {_MAX_RANK}")
         shape_vec[y_off] = len(y_feat)
         shape_vec[y_off + 1 : y_off + 1 + len(y_feat)] = y_feat
+    dt_off = y_off + 1 + _MAX_RANK
+    shape_vec[dt_off] = _dtype_code(local_x.dtype)
+    shape_vec[dt_off + 1] = (
+        _dtype_code(local_y.dtype) if local_y is not None else -1
+    )
     gathered = multihost_utils.process_allgather(shape_vec)
     gathered = gathered.reshape(-1, width)
     counts = gathered[:, 0]
@@ -516,14 +534,19 @@ def train_distributed_multihost(
         if len(donors):
             nd = int(donors[0, 1])
             feat = tuple(int(v) for v in donors[0, 2 : 2 + nd])
-            local_x = np.zeros((0,) + feat, np.float32)
+            local_x = np.zeros((0,) + feat,
+                               _DTYPES[int(donors[0, dt_off])])
             if local_y is not None:
                 y_rank = int(donors[0, y_off])
                 y_feat = (
                     tuple(int(v) for v in donors[0, y_off + 1 : y_off + 1 + y_rank])
                     if y_rank > 0 else ()
                 )
-                local_y = np.zeros((0,) + y_feat, local_y.dtype)
+                y_code = int(donors[0, dt_off + 1])
+                local_y = np.zeros(
+                    (0,) + y_feat,
+                    _DTYPES[y_code] if y_code >= 0 else local_y.dtype,
+                )
     # Unsupervised (y=x) aliasing AFTER the donor repair, so the empty
     # host's labels adopt the repaired feature shape too.
     if local_y is None:
@@ -539,6 +562,18 @@ def train_distributed_multihost(
         shards_per_host,
         -(-per_host // shards_per_host) * shards_per_host,
     )
+    from sparktorch_tpu.parallel.mesh import AXIS_PP as _PP
+
+    if dict(mesh.shape).get(_PP, 1) > 1:
+        # The pp route needs global rows divisible by dp * n_micro
+        # (each dp shard splits into n_micro microbatches). Round
+        # per_host up so per_host * n_proc satisfies that.
+        import math as _math
+
+        dp_sz = mesh.shape[BATCH_AXES[0]]
+        need = dp_sz * int(kwargs.get("n_micro", 4))
+        unit = need // _math.gcd(n_proc, need)
+        per_host = -(-per_host // unit) * unit
 
     def pad_to(arr, n):
         if arr.shape[0] == n:

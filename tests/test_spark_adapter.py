@@ -171,6 +171,76 @@ def test_barrier_mode_two_process_world(data):
 
 
 @pytest.mark.slow
+def test_barrier_two_process_pp_pre_sharded(spark):
+    """pre_sharded under pp>1 (the last Param-contract gap): a
+    gang-launched 2-process world assembles the global batch with
+    train_distributed_multihost and trains a pipeline-parallel LM —
+    the pp route consuming the globally-sharded DataBatch directly
+    (pre_sharded=True), dp=8 x pp=2 over the 16-device world."""
+    import numpy as _np
+
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.models.transformer import TransformerConfig
+    from sparktorch_tpu.native.gang import GangCoordinator
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=4, d_ff=64, max_len=16,
+                            dtype="float32")
+    payload = serialize_model(CausalLM(cfg), "cross_entropy", "adam",
+                              {"lr": 1e-2}, input_shape=(16,))
+    rng = _np.random.default_rng(0)
+    ids = rng.integers(0, 64, (16, 17))
+    rows = [(float(i), DenseVector(ids[i].astype(float))) for i in range(16)]
+    df = spark.createDataFrame(rows, ["idx", "tokens"]).repartition(2)
+
+    coord = GangCoordinator(world_size=2, port=0)
+    gang_port = coord.port
+
+    def run_host(iterator):
+        import numpy as np
+        from pyspark import BarrierTaskContext
+
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        toks = np.stack([
+            np.asarray(r[0].toArray(), np.int64) for r in iterator
+        ]).astype(np.int32)
+
+        from sparktorch_tpu.parallel.launch import bringup_multihost
+        from sparktorch_tpu.train.sync import train_distributed_multihost
+
+        _, worker = bringup_multihost(
+            rank=rank, world_size=2, coordinator_host="127.0.0.1",
+            gang_port=gang_port, start_coordinator=False,
+        )
+        try:
+            import jax
+
+            from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+
+            mesh = build_mesh(MeshConfig(pp=2))  # dp=8 x pp=2 over 16
+            result = train_distributed_multihost(
+                payload, toks[:, :-1], local_y=toks[:, 1:], mesh=mesh,
+                iters=4, n_micro=2,
+            )
+            if rank == 0:
+                yield [m["loss"] for m in result.metrics]
+        finally:
+            if worker is not None:
+                worker.close()
+
+    try:
+        rdd = df.select("tokens").rdd
+        out = rdd.barrier().mapPartitions(run_host).collect()
+    finally:
+        coord.stop()
+    (losses,) = out
+    assert len(losses) == 4
+    assert all(_np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
 def test_barrier_mode_empty_partition(spark):
     """3 barrier tasks, 2 rows: one task has NO data and must still
     enter the collectives (weight-0 shape agreement — the reference's
