@@ -285,10 +285,12 @@ def bench_resnet18_hogwild() -> dict:
     # measured runs compile-free.
     train_async(spec, x, labels=y, iters=8, mini_batch=mb, push_every=4)
 
-    def _one_run() -> tuple[float, dict]:
+    def _one_run(transport: str = "local",
+                 run_iters: int = iters) -> tuple[float, dict, dict]:
         t0 = time.perf_counter()
-        result = train_async(spec, x, labels=y, iters=iters, mini_batch=mb,
-                             push_every=4)
+        result = train_async(spec, x, labels=y, iters=run_iters,
+                             mini_batch=mb, push_every=4,
+                             transport=transport)
         dt = time.perf_counter() - t0
         n_workers = len(jax.devices())
         # One push per window: count distinct (worker, dispatch-ts)
@@ -310,9 +312,10 @@ def bench_resnet18_hogwild() -> dict:
             steady = n_steady * mb / (max(t_done) - uts[1]) / n_workers
         else:
             steady = n_rec * mb / dt / n_workers
+        budget = (result.summary or {}).get("hogwild_budget", {})
         return steady, {"n_chips": n_workers, "pushes": pushes,
                         "iters_recorded": n_rec, "dt": dt,
-                        "final_loss": result.metrics[-1]["loss"]}
+                        "final_loss": result.metrics[-1]["loss"]}, budget
 
     # Five measured repeats: report the median and the spread so a
     # regression is distinguishable from run-to-run variance. The
@@ -320,13 +323,44 @@ def bench_resnet18_hogwild() -> dict:
     # contradict the headline rate.
     runs = sorted([_one_run() for _ in range(5)], key=lambda r: r[0])
     rates = [r[0] for r in runs]
-    per_chip, info = runs[len(runs) // 2]
+    per_chip, info, budget = runs[len(runs) // 2]
     spread_pct = 100.0 * (rates[-1] - rates[0]) / max(
         rates[len(rates) // 2], 1e-9
     )
     times = [info["dt"] / max(1, info["iters_recorded"])] * max(
         1, info["iters_recorded"]
     )
+
+    # The decomposition the efficiency ratio owes: where the median
+    # run's worker wall time went, as fractions that sum to ~1
+    # (pull wire, pulled-params placement, async dispatch, the push's
+    # device-draining materialize fence, push wire + server apply,
+    # stop-poll, and unattributed loop bookkeeping).
+    budget_rec = {}
+    if budget and budget.get("loop_s"):
+        loop_s = budget["loop_s"]
+        phases = ("pull_s", "pull_place_s", "dispatch_s",
+                  "push_materialize_s", "push_wire_s", "poll_s",
+                  "other_s")
+        budget_rec = {
+            "budget_loop_s": round(loop_s, 3),
+            **{f"budget_{k}": round(budget.get(k, 0.0), 3)
+               for k in phases},
+            "budget_fractions": {
+                k: round(budget.get(k, 0.0) / loop_s, 4) for k in phases
+            },
+            "pull_mb": round(budget.get("pull_bytes", 0) / 1e6, 2),
+            "push_mb": round(budget.get("push_bytes", 0) / 1e6, 2),
+            "pulls": int(budget.get("pulls", 0)),
+            "pull_fresh": int(budget.get("pull_fresh", 0)),
+        }
+
+    # Wire ablation: the same workload over the HTTP transport (the
+    # reference's deployment wire). local-vs-http separates the DESIGN
+    # overhead (server round-trips, pull placement, materialize
+    # fences) from the WIRE itself.
+    http_rate, _, http_budget = _one_run(transport="http",
+                                         run_iters=max(64, iters // 4))
 
     # Sync twin at the same PER-CHIP batch: each hogwild worker
     # computes 256-row minibatches, so the sync leg runs 256 rows per
@@ -351,6 +385,15 @@ def bench_resnet18_hogwild() -> dict:
         "final_loss": info["final_loss"],
         "sync_examples_per_sec_per_chip": sync_rate,
         "async_efficiency_vs_sync": round(per_chip / max(sync_rate, 1e-9), 3),
+        "http_examples_per_sec_per_chip": round(http_rate, 1),
+        "async_efficiency_http_vs_local": round(
+            http_rate / max(per_chip, 1e-9), 3
+        ),
+        "http_push_wire_s_per_push": round(
+            http_budget.get("push_wire_s", 0.0)
+            / max(1, http_budget.get("pushes", 1)), 4
+        ),
+        **budget_rec,
         **_steps_summary(times),
     }
 

@@ -48,20 +48,47 @@ _HTTP_TIMEOUT = 10.0  # hogwild.py:34-38 parity (10s timeout, 1 retry)
 # ---------------------------------------------------------------------------
 
 
+def _new_phase_stats() -> dict:
+    """Per-transport phase accounting (seconds, bytes, counts) — the
+    raw material for the hogwild budget the bench publishes: where a
+    worker's wall time actually goes (pull wire, push materialize+wire,
+    stop-poll), so ``async_efficiency`` decomposes instead of being one
+    unexplained ratio."""
+    return {
+        "pull_s": 0.0, "pull_bytes": 0, "pulls": 0, "pull_fresh": 0,
+        "push_wire_s": 0.0, "push_materialize_s": 0.0,
+        "push_bytes": 0, "pushes": 0,
+        "poll_s": 0.0,
+    }
+
+
 class LocalTransport:
     """Direct in-process access to the server object."""
 
     def __init__(self, server: ParameterServer):
         self.server = server
+        self.stats = _new_phase_stats()
 
     def pull(self, have_version: int):
-        return self.server.get_parameters(have_version)
+        t0 = time.perf_counter()
+        snap = self.server.get_parameters(have_version)
+        st = self.stats
+        st["pull_s"] += time.perf_counter() - t0
+        st["pulls"] += 1
+        st["pull_fresh"] += snap is not None
+        return snap
 
     def push(self, grads) -> None:
+        t0 = time.perf_counter()
         self.server.push_gradients(grads)
+        self.stats["push_wire_s"] += time.perf_counter() - t0
+        self.stats["pushes"] += 1
 
     def post_loss(self, loss: float) -> bool:
-        return self.server.post_loss(loss)
+        t0 = time.perf_counter()
+        out = self.server.post_loss(loss)
+        self.stats["poll_s"] += time.perf_counter() - t0
+        return out
 
     def alive(self) -> bool:
         return True
@@ -81,6 +108,7 @@ class HttpTransport:
     def __init__(self, url: str, compress: bool = True):
         self.url = url.rstrip("/")
         self.compress = compress
+        self.stats = _new_phase_stats()
 
     def _request(self, req):
         try:
@@ -89,15 +117,30 @@ class HttpTransport:
             return urllib.request.urlopen(req, timeout=_HTTP_TIMEOUT)  # retry once
 
     def pull(self, have_version: int):
+        st = self.stats
+        t0 = time.perf_counter()
         req = urllib.request.Request(
             self.url + "/parameters", headers={"X-Have-Version": str(have_version)}
         )
         with self._request(req) as resp:
             if resp.status == 204:
+                st["pull_s"] += time.perf_counter() - t0
+                st["pulls"] += 1
                 return None
-            return dill.loads(resp.read())
+            body = resp.read()
+        st["pull_s"] += time.perf_counter() - t0
+        st["pulls"] += 1
+        st["pull_fresh"] += 1
+        st["pull_bytes"] += len(body)
+        return dill.loads(body)
 
     def push(self, grads) -> None:
+        st = self.stats
+        # Materialize separately from the wire: np.asarray FENCES the
+        # device (the gradient compute drains here), so this term is
+        # the honest compute+download time and the urlopen below is the
+        # pure wire+server-apply time.
+        t0 = time.perf_counter()
         if self.compress:
             host_grads = jax.tree.map(
                 lambda a: np.asarray(
@@ -109,19 +152,28 @@ class HttpTransport:
             )
         else:
             host_grads = jax.tree.map(lambda a: np.asarray(a), grads)
+        t1 = time.perf_counter()
+        st["push_materialize_s"] += t1 - t0
+        payload = dill.dumps(host_grads)
         req = urllib.request.Request(
-            self.url + "/update", data=dill.dumps(host_grads), method="POST"
+            self.url + "/update", data=payload, method="POST"
         )
         with self._request(req) as resp:
             if resp.status != 200:
                 raise RuntimeError(f"/update failed: {resp.status}")
+        st["push_wire_s"] += time.perf_counter() - t1
+        st["push_bytes"] += len(payload)
+        st["pushes"] += 1
 
     def post_loss(self, loss: float) -> bool:
+        t0 = time.perf_counter()
         req = urllib.request.Request(
             self.url + "/losses", data=dill.dumps(float(loss)), method="POST"
         )
         with self._request(req) as resp:
-            return bool(dill.loads(resp.read())["stop"])
+            out = bool(dill.loads(resp.read())["stop"])
+        self.stats["poll_s"] += time.perf_counter() - t0
+        return out
 
     def alive(self) -> bool:
         # GET / liveness probe (hogwild.py:60-62).
@@ -255,6 +307,7 @@ def _worker_loop(
     push_every: int = 1,
     eval_loss=None,
     grad_windows=None,
+    phase_out: Optional[List[dict]] = None,
 ):
     """One worker's training loop.
 
@@ -269,6 +322,10 @@ def _worker_loop(
     more than the gradient step itself on remote-attached chips.
     """
     try:
+        if hasattr(transport, "stats"):
+            # Fresh per-round stats: the transport object survives
+            # shuffle rounds, the budget must not double-count.
+            transport.stats = _new_phase_stats()
         shard = jax.device_put(shard, device)
         key = jax.device_put(jax.random.key(seed + worker_id), device)
         have_version = -1
@@ -276,20 +333,28 @@ def _worker_loop(
         pending: List[Any] = []
         window_k = push_every if push_every and push_every > 1 else 1
         it = 0
+        t_place = 0.0   # host->device upload of pulled params
+        t_dispatch = 0.0  # grad window dispatch (async; drain lands
+        # in the push's materialize fence)
+        t_loop0 = time.perf_counter()
         while it < iters:
             snap = transport.pull(have_version)
             if snap is not None:
                 have_version, params = snap
+                t0 = time.perf_counter()
                 params = jax.device_put(params, device)
+                t_place += time.perf_counter() - t0
 
             key, sub = jax.random.split(key)
             k = min(window_k, iters - it)
+            t0 = time.perf_counter()
             if window_k > 1 and grad_windows is not None:
                 fn = grad_windows[0] if k == window_k else grad_windows[1]
                 grads, losses = fn(params, model_state, shard, sub)
             else:
                 k = 1
                 grads, losses = grad_step(params, model_state, shard, sub)
+            t_dispatch += time.perf_counter() - t0
             transport.push(grads)
             pending.append((it, k, have_version, losses, time.perf_counter()))
             it += k
@@ -321,6 +386,16 @@ def _worker_loop(
             # throughput math.
             done[-1]["t_done"] = time.perf_counter()
         records.extend(done)
+        if phase_out is not None:
+            st = dict(getattr(transport, "stats", {}) or {})
+            st.update({
+                "worker": worker_id,
+                "pull_place_s": t_place,
+                "dispatch_s": t_dispatch,
+                "loop_s": time.perf_counter() - t_loop0,
+                "iters": it,
+            })
+            phase_out.append(st)
     except BaseException as e:  # surfaced to the driver
         errors.append(e)
 
@@ -402,6 +477,7 @@ def train_async(
 
         records: List[dict] = []
         errors: List[BaseException] = []
+        phase_stats: List[dict] = []
         x = np.asarray(train_batch.x)
         y = np.asarray(train_batch.y)
         w = np.asarray(train_batch.w)
@@ -443,6 +519,7 @@ def train_async(
                         push_every,
                         eval_loss,
                         grad_windows,
+                        phase_stats,
                     ),
                     daemon=True,
                 )
@@ -458,8 +535,30 @@ def train_async(
         params, model_state = server.final_state()
         params = jax.device_get(params)
         model_state = jax.device_get(model_state)
+        summary = None
+        if phase_stats:
+            # The budget that sums to the whole: per-phase seconds
+            # across workers; other_s is loop bookkeeping (python,
+            # record-keeping) not attributed to a phase.
+            keys = ("pull_s", "pull_place_s", "dispatch_s",
+                    "push_materialize_s", "push_wire_s", "poll_s",
+                    "loop_s", "pull_bytes", "push_bytes", "pulls",
+                    "pushes", "pull_fresh")
+            tot = {k: float(sum(d.get(k, 0) for d in phase_stats))
+                   for k in keys}
+            tot["other_s"] = tot["loop_s"] - sum(
+                tot[k] for k in ("pull_s", "pull_place_s", "dispatch_s",
+                                 "push_materialize_s", "push_wire_s",
+                                 "poll_s")
+            )
+            summary = {
+                "hogwild_phases": phase_stats,
+                "hogwild_budget": tot,
+                "server_applied": server.applied_updates,
+            }
         return TrainResult(
-            params=params, model_state=model_state, metrics=records, spec=spec
+            params=params, model_state=model_state, metrics=records,
+            spec=spec, summary=summary,
         )
     finally:
         # Stop server even on failure (hogwild.py:184-186 parity).

@@ -498,13 +498,19 @@ def train_distributed_multihost(
     # the dtype codes let the repair match the donors' dtype too (an
     # int-token host must not be joined by a float32 empty shard).
     _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.int8,
-               np.uint8, np.int16, np.uint16]
+               np.uint8, np.int16, np.uint16, np.uint32, np.uint64,
+               np.bool_]
 
     def _dtype_code(dt) -> int:
         for i, d in enumerate(_DTYPES):
             if np.dtype(dt) == np.dtype(d):
                 return i
-        return 0  # treat anything exotic as float32
+        # Silently coding an unknown dtype as float32 would let an
+        # empty host repair itself with a dtype its donors don't have.
+        raise ValueError(
+            f"unsupported multihost shard dtype {np.dtype(dt)}; use one "
+            f"of {[np.dtype(d).name for d in _DTYPES]}"
+        )
 
     width = 2 + _MAX_RANK + 1 + _MAX_RANK + 2
     shape_vec = np.full((width,), 0, np.int64)
@@ -548,7 +554,22 @@ def train_distributed_multihost(
                     _DTYPES[y_code] if y_code >= 0 else local_y.dtype,
                 )
     # Unsupervised (y=x) aliasing AFTER the donor repair, so the empty
-    # host's labels adopt the repaired feature shape too.
+    # host's labels adopt the repaired feature shape too. The pp route
+    # must never see the alias: its heads are an LM (targets are the
+    # NEXT token — alias the raw matrix and it trains an identity
+    # copier) or a classifier (needs real labels).
+    if local_y is None and dict(mesh.shape).get("pp", 1) > 1:
+        from sparktorch_tpu.models.transformer import CausalLM as _CLM
+
+        probe = deserialize_model(torch_obj)
+        if isinstance(probe.make_module(), _CLM) and local_x.ndim == 2:
+            local_x, local_y = local_x[:, :-1], local_x[:, 1:]
+        else:
+            raise ValueError(
+                "pp>1 multihost training requires labels (local_y): "
+                "next-token targets for a CausalLM id matrix, or class "
+                "labels for a classifier"
+            )
     if local_y is None:
         local_y = local_x
     local_w = np.ones((local_x.shape[0],), np.float32)
