@@ -168,7 +168,14 @@ def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
     # An RTT drop between the paired spans can push a sample to ~0 or
     # negative; the median over repeats is robust to those, but drop
     # them from the reported spread so it reflects usable samples.
+    # Trim SYMMETRICALLY: a near-zero positive slope is the same RTT
+    # artifact as a negative one, and leaving it in wildly inflates
+    # rate_best/rate_spread_pct (ADVICE r04) — anything below 20% of
+    # the positive median is jitter, not a measurement.
     good = [s for s in slopes if s > 0]
+    if good:
+        floor = 0.2 * float(np.median(good))
+        good = [s for s in good if s >= floor]
     if not good:
         # Degenerate link (every sample non-positive): fall back to
         # the whole-span mean INCLUDING its one sync cost — an upper

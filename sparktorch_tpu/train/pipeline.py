@@ -1922,11 +1922,17 @@ def make_pp_train_step(
                 # device_get(state.step) fold would cost per call.
                 key = cache.setdefault("zero_key", jax.random.key(0))
             else:
-                # Deterministic per-call key for minibatch sampling
-                # (host-side step counter seeded ONCE from the device
-                # step, so fresh blocks are drawn each call without a
-                # per-call device sync).
-                if "host_step" not in cache:
+                # Deterministic per-call key for minibatch sampling:
+                # a host-side step counter seeded from the device step,
+                # so fresh blocks are drawn each call without a
+                # per-call device sync. The counter is resynced (one
+                # device_get) whenever the caller passes a state this
+                # step fn did NOT produce — a restored checkpoint or a
+                # fresh PipelineState — detected by identity on the
+                # step array, so resumed runs key off the true
+                # state.step instead of a stale cache (ADVICE r04).
+                if ("host_step" not in cache
+                        or state.step is not cache.get("last_step_arr")):
                     cache["host_step"] = int(jax.device_get(state.step))
                 key = jax.random.fold_in(
                     jax.random.key(0), cache["host_step"]
@@ -1937,6 +1943,7 @@ def make_pp_train_step(
         ](state.params, state.opt_state, batch.x, batch.y, batch.w, key)
         new_state = PipelineState(step=state.step + K, params=new_params,
                                   opt_state=new_opt)
+        cache["last_step_arr"] = new_state.step
         if K == 1:
             # Introspection hooks (concrete post-jit values), same
             # single-step contract as before for existing callers.
@@ -2229,6 +2236,24 @@ def train_distributed_pipeline(
         checkpoint_every=checkpoint_every,
         ckpt_active=bool(checkpoint_dir),
     )
+    if (steps_per_call > 1
+            and ((early_stop_patience and early_stop_patience > 0)
+                 or validation_pct > 0)):
+        # The default resolution already picks 1 when these signals
+        # are active, so reaching here means an EXPLICIT override:
+        # make the cadence change loud rather than silent (ADVICE
+        # r04 — patience would otherwise silently multiply by the
+        # chunk size).
+        import warnings
+
+        warnings.warn(
+            f"steps_per_call={steps_per_call} with early stopping / "
+            "validation on the pp path: the stop signal and val loss "
+            "are evaluated at COMPILED-CALL boundaries, so "
+            "early_stop_patience counts calls (each "
+            f"{steps_per_call} steps), not steps",
+            stacklevel=2,
+        )
 
     tx = spec.make_optimizer()
     # Build the step FIRST: its config validation (stage divisibility,
@@ -2357,11 +2382,16 @@ def train_distributed_pipeline(
                         if out.drop_fraction is not None
                         else [None] * steps_per_call
                     )
+                # Time the once-per-call eval separately: smearing it
+                # into the per-step dt would inflate step_time_s by
+                # eval_wall/steps_per_call (ADVICE r04).
+                t_eval0 = time.perf_counter()
                 val_loss = (
                     float(step.eval_loss(state, val_batch))
                     if val_batch is not None else None
                 )
-                dt = (time.perf_counter() - t0) / len(losses)
+                eval_s = time.perf_counter() - t_eval0
+                dt = (time.perf_counter() - t0 - eval_s) / len(losses)
                 for j, (l, g, e, dr) in enumerate(
                     zip(losses, gnorms, exs, drops)
                 ):
@@ -2376,6 +2406,8 @@ def train_distributed_pipeline(
                         "grad_norm": g,
                         "step_time_s": dt,
                     }
+                    if val_loss is not None and j == len(losses) - 1:
+                        record["eval_time_s"] = eval_s
                     if dr is not None:
                         record["moe_drop_fraction"] = dr
                     recorder.record(record)
