@@ -179,6 +179,17 @@ def _gspmd_constraint(x, spec: P):
         for ax in axes:
             if ax not in types or "Manual" in str(types[ax]):
                 return x
+        # Each constrained dim must divide its axes' total extent —
+        # constraining a 1-group tensor across 8 devices just forces
+        # an involuntary full reshard (SPMD partitioner warning).
+        for dim, part in zip(x.shape, spec):
+            if part is None:
+                continue
+            total = 1
+            for a in (part if isinstance(part, tuple) else (part,)):
+                total *= am.shape[a]
+            if total > 1 and dim % total != 0:
+                return x
         return jax.lax.with_sharding_constraint(x, spec)
     except Exception:  # no mesh context / legacy jax — layout hint only
         return x
