@@ -64,23 +64,29 @@ def _steps_summary(times: List[float]) -> Dict[str, float]:
     }
 
 
-def _xla_cost_per_step(epoch, state, batch, steps_per_call: int):
-    """XLA's own accounting for ONE fused chunk, normalized per step:
-    ``flops`` (executed HLO flops — includes optimizer, layernorms,
-    any remat) and ``bytes accessed`` (HBM traffic as modeled by the
-    compiler). Both are PER-DEVICE numbers — cost_analysis runs on the
-    SPMD-partitioned per-device module (verified against a hand-counted
-    matmul on an 8-device mesh) — so they compare directly against
-    single-chip peaks. This is the methodology-free cross-check for
-    every analytic MFU number: the same compiled program every measured
-    span runs, costed by the compiler that scheduled it.
+def _xla_cost_per_step(epoch, epoch1, state, batch):
+    """XLA's own accounting for ONE train step: ``flops`` (executed
+    HLO flops — includes optimizer, layernorms, any remat) and
+    ``bytes accessed`` (HBM traffic as modeled by the compiler). Both
+    are PER-DEVICE numbers — cost_analysis runs on the SPMD-partitioned
+    per-device module (verified against a hand-counted matmul on an
+    8-device mesh). The analysis runs on a SINGLE-step program
+    (``epoch1``): backends disagree on whether a scanned chunk's while
+    body is counted once or trip-count times (TPU counts it once —
+    discovered when the 10-step chunk reported exactly 1/10 of the
+    analytic FLOPs), and a length-1 program is unambiguous either way.
+    This is the methodology-free cross-check for every analytic MFU
+    number, costed by the compiler that scheduled it.
 
-    Returns ``(cost_dict_or_None, compiled_or_None)`` — the caller
-    reuses the AOT-compiled executable for the measured calls so the
-    chunk is not compiled a second time by the jit cache."""
+    Returns ``(cost_dict_or_None, compiled_or_None)`` — ``compiled``
+    is the AOT executable of the MEASURED chunk, which the caller
+    reuses so the jit cache doesn't compile it a second time."""
     try:
         compiled = epoch.lower(state, batch).compile()
-        ca = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    try:
+        ca = epoch1.lower(state, batch).compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         flops = float(ca.get("flops", -1.0))
@@ -88,11 +94,12 @@ def _xla_cost_per_step(epoch, state, batch, steps_per_call: int):
         if flops <= 0:
             return None, compiled
         return {
-            "xla_flops_per_step": flops / steps_per_call,
-            "xla_bytes_per_step": (byts / steps_per_call) if byts > 0 else None,
+            "xla_flops_per_step": flops,
+            "xla_bytes_per_step": byts if byts > 0 else None,
         }, compiled
-    except Exception:  # cost_analysis availability varies by backend
-        return None, None
+    except Exception:  # cost_analysis availability varies by backend;
+        # keep the measured chunk's AOT executable either way.
+        return None, compiled
 
 
 def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
@@ -135,7 +142,9 @@ def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
                              mesh, steps_per_call=iters)
     cost = None
     if with_cost_analysis:
-        cost, compiled = _xla_cost_per_step(epoch, state, batch, iters)
+        epoch1 = make_train_epoch(spec.make_module().apply, spec.loss_fn(),
+                                  tx, mesh, steps_per_call=1)
+        cost, compiled = _xla_cost_per_step(epoch, epoch1, state, batch)
         if compiled is not None:
             epoch = compiled  # one compile serves analysis AND timing
     for _ in range(warmup):
@@ -608,12 +617,13 @@ def bench_resnet50_inference() -> dict:
         ),
         "wire_dtype": "uint8 (normalize + argmax fused on device)",
     }
-    # Attach the LARGEST measured long-haul run on record (the r04 1M
-    # run when present, else the r03 100k run).
+    # Attach the LARGEST measured long-haul run on record across the
+    # retained round logs (r03 100k, r04 1M, r05 segments).
     bench_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "benchmarks")
     big = []
-    for name in ("bench_r03_tpu.jsonl", "bench_r04_tpu.jsonl"):
+    for name in ("bench_r03_tpu.jsonl", "bench_r04_tpu.jsonl",
+                 "bench_r05_tpu.jsonl"):
         try:
             with open(os.path.join(bench_dir, name)) as f:
                 runs = [json.loads(line) for line in f if line.strip()]
@@ -747,7 +757,7 @@ def _headline() -> dict:
     slope samples (see ``_sync_epoch_bench``), with best/spread/raw
     samples carried alongside so regression vs noise is decidable from
     the line itself; every run also appends the full record to
-    ``benchmarks/bench_r04_tpu.jsonl``."""
+    ``benchmarks/bench_r05_tpu.jsonl``."""
     out = bench_mnist_cnn_sync()
     per_chip = out["examples_per_sec_per_chip"]
     rec = {
@@ -764,7 +774,7 @@ def _headline() -> dict:
         import os
 
         log = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "benchmarks", "bench_r04_tpu.jsonl")
+            os.path.abspath(__file__))), "benchmarks", "bench_r05_tpu.jsonl")
         with open(log, "a") as f:
             f.write(json.dumps({
                 **out, "source": "headline",
