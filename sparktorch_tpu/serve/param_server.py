@@ -259,6 +259,30 @@ class ParamServerHttp:
 
     def start(self):
         ps = self.server
+        # Version-keyed cache of the dill-serialized host snapshot:
+        # materializing device params costs a full host download (on a
+        # tunnel-attached chip, seconds per pull) — pay it once per
+        # VERSION, not once per worker pull. The slot's version tag
+        # makes staleness detection free.
+        wire_cache: dict = {"version": None, "body": None}
+        wire_lock = threading.Lock()
+
+        def _cached_body():
+            """(version, body) from ONE slot read — the handler's
+            freshness decision and the served bytes share a source of
+            truth. Serialization happens UNDER the lock: when a new
+            version lands and every worker pulls at once, late
+            arrivals block briefly and reuse the one body instead of
+            each paying the multi-second host download (and a slow
+            dump can never overwrite a newer cached entry)."""
+            with wire_lock:
+                version, params = ps.slot.read()
+                if wire_cache["version"] != version:
+                    wire_cache["body"] = dill.dumps(
+                        (version, _to_host(params))
+                    )
+                    wire_cache["version"] = version
+                return version, wire_cache["body"]
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet, like werkzeug->ERROR
@@ -276,12 +300,10 @@ class ParamServerHttp:
                     self._send(200, b"sparktorch-tpu parameter server")
                 elif self.path.startswith("/parameters"):
                     have = int(self.headers.get("X-Have-Version", "-1"))
-                    snap = ps.get_parameters(have)
-                    if snap is None:
+                    version, body = _cached_body()
+                    if version <= have:
                         self._send(204)
                     else:
-                        version, params = snap
-                        body = dill.dumps((version, _to_host(params)))
                         self._send(200, body)
                 else:
                     self._send(404)

@@ -41,6 +41,11 @@ from sparktorch_tpu.utils.data import DataBatch
 from sparktorch_tpu.utils.serde import deserialize_model
 
 _HTTP_TIMEOUT = 10.0  # hogwild.py:34-38 parity (10s timeout, 1 retry)
+# Pulls carry the full model snapshot; on a tunnel-attached chip the
+# server's first host materialization of a new version takes seconds,
+# so the pull deadline is its own (the push/poll paths keep reference
+# parity).
+_HTTP_PULL_TIMEOUT = 60.0
 
 
 # ---------------------------------------------------------------------------
@@ -110,11 +115,19 @@ class HttpTransport:
         self.compress = compress
         self.stats = _new_phase_stats()
 
-    def _request(self, req):
+    def _request(self, req, timeout: float = _HTTP_TIMEOUT,
+                 retry_on_timeout: bool = False):
+        """One retry, reference parity. Timeouts retry only when the
+        caller says the request is IDEMPOTENT (the pull GET): a timed-
+        out POST may still complete server-side, and re-sending it
+        would double-apply a gradient or double-count a loss."""
+        retriable = (urllib.error.URLError, ConnectionError)
+        if retry_on_timeout:
+            retriable = retriable + (TimeoutError,)
         try:
-            return urllib.request.urlopen(req, timeout=_HTTP_TIMEOUT)
-        except (urllib.error.URLError, ConnectionError):
-            return urllib.request.urlopen(req, timeout=_HTTP_TIMEOUT)  # retry once
+            return urllib.request.urlopen(req, timeout=timeout)
+        except retriable:
+            return urllib.request.urlopen(req, timeout=timeout)  # retry once
 
     def pull(self, have_version: int):
         st = self.stats
@@ -122,7 +135,8 @@ class HttpTransport:
         req = urllib.request.Request(
             self.url + "/parameters", headers={"X-Have-Version": str(have_version)}
         )
-        with self._request(req) as resp:
+        with self._request(req, timeout=_HTTP_PULL_TIMEOUT,
+                           retry_on_timeout=True) as resp:
             if resp.status == 204:
                 st["pull_s"] += time.perf_counter() - t0
                 st["pulls"] += 1
