@@ -357,7 +357,7 @@ def bench_resnet18_hogwild() -> dict:
         loop_s = budget["loop_s"]
         phases = ("pull_s", "pull_place_s", "dispatch_s",
                   "push_materialize_s", "push_wire_s", "poll_s",
-                  "other_s")
+                  "drain_s", "other_s")
         budget_rec = {
             "budget_loop_s": round(loop_s, 3),
             **{f"budget_{k}": round(budget.get(k, 0.0), 3)
@@ -374,9 +374,21 @@ def bench_resnet18_hogwild() -> dict:
     # Wire ablation: the same workload over the HTTP transport (the
     # reference's deployment wire). local-vs-http separates the DESIGN
     # overhead (server round-trips, pull placement, materialize
-    # fences) from the WIRE itself.
-    http_rate, _, http_budget = _one_run(transport="http",
-                                         run_iters=max(64, iters // 4))
+    # fences) from the WIRE itself. Fault-isolated: a tunnel trough
+    # stalling a 45 MB pull past even the generous deadline must not
+    # discard the already-measured local numbers — the failure is
+    # recorded instead.
+    try:
+        http_rate, _, http_budget = _one_run(transport="http",
+                                             run_iters=max(64, iters // 4))
+        http_error = None
+    except Exception as e:
+        http_rate, http_budget = 0.0, {}
+        http_error = f"{type(e).__name__}: {e}"
+        if e.__cause__ is not None:  # the worker's root failure
+            http_error += (f" (from {type(e.__cause__).__name__}: "
+                           f"{e.__cause__})")
+        http_error = http_error[:300]
 
     # Sync twin at the same PER-CHIP batch: each hogwild worker
     # computes 256-row minibatches, so the sync leg runs 256 rows per
@@ -409,6 +421,7 @@ def bench_resnet18_hogwild() -> dict:
             http_budget.get("push_wire_s", 0.0)
             / max(1, http_budget.get("pushes", 1)), 4
         ),
+        **({"http_ablation_error": http_error} if http_error else {}),
         **budget_rec,
         **_steps_summary(times),
     }

@@ -42,10 +42,11 @@ from sparktorch_tpu.utils.serde import deserialize_model
 
 _HTTP_TIMEOUT = 10.0  # hogwild.py:34-38 parity (10s timeout, 1 retry)
 # Pulls carry the full model snapshot; on a tunnel-attached chip the
-# server's first host materialization of a new version takes seconds,
-# so the pull deadline is its own (the push/poll paths keep reference
-# parity).
-_HTTP_PULL_TIMEOUT = 60.0
+# server's first host materialization of a new version takes seconds —
+# and the rig's wire oscillates down to <1 MB/s in troughs — so the
+# pull deadline is its own, generous one (the push/poll paths keep
+# reference parity).
+_HTTP_PULL_TIMEOUT = 180.0
 
 
 # ---------------------------------------------------------------------------
@@ -385,6 +386,7 @@ def _worker_loop(
                     )
                 if transport.post_loss(signal):
                     break
+        t_drain0 = time.perf_counter()
         done = []
         for start, k, version, losses, ts in pending:
             vals = np.asarray(losses).reshape(-1)
@@ -406,6 +408,11 @@ def _worker_loop(
                 "worker": worker_id,
                 "pull_place_s": t_place,
                 "dispatch_s": t_dispatch,
+                # The post-loop loss materialization: where the async
+                # window dispatches' device compute + link latency
+                # actually drains (dominant with the local transport —
+                # this IS the per-window-dispatch design cost).
+                "drain_s": time.perf_counter() - t_drain0,
                 "loop_s": time.perf_counter() - t_loop0,
                 "iters": it,
             })
@@ -556,14 +563,14 @@ def train_async(
             # record-keeping) not attributed to a phase.
             keys = ("pull_s", "pull_place_s", "dispatch_s",
                     "push_materialize_s", "push_wire_s", "poll_s",
-                    "loop_s", "pull_bytes", "push_bytes", "pulls",
-                    "pushes", "pull_fresh")
+                    "drain_s", "loop_s", "pull_bytes", "push_bytes",
+                    "pulls", "pushes", "pull_fresh")
             tot = {k: float(sum(d.get(k, 0) for d in phase_stats))
                    for k in keys}
             tot["other_s"] = tot["loop_s"] - sum(
                 tot[k] for k in ("pull_s", "pull_place_s", "dispatch_s",
                                  "push_materialize_s", "push_wire_s",
-                                 "poll_s")
+                                 "poll_s", "drain_s")
             )
             summary = {
                 "hogwild_phases": phase_stats,

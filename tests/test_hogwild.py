@@ -199,7 +199,8 @@ def test_hogwild_phase_budget_sums_to_whole(payload):
     counts wire bytes; shuffle rounds don't double-count."""
     x, y = _blob_data()
     phases = ("pull_s", "pull_place_s", "dispatch_s",
-              "push_materialize_s", "push_wire_s", "poll_s", "other_s")
+              "push_materialize_s", "push_wire_s", "poll_s",
+              "drain_s", "other_s")
     for transport, expect_bytes in (("local", False), ("http", True)):
         result = train_async(payload, x, labels=y, iters=8, partitions=2,
                              mini_batch=32, push_every=4, seed=0,
