@@ -213,7 +213,7 @@ class SparkTorch(Estimator):
                  port=None, useBarrier=None, useVectorOut=None,
                  earlyStopPatience=None, miniBatch=None, validationPct=None,
                  pushEvery=None, mesh=None, seed=None, n_micro=None,
-                 pipeline_schedule=None):
+                 pipeline_schedule=None, virtual_stages=None):
         super().__init__()
         # Defaults mirror torch_distributed.py:178-196.
         self._setDefault(
@@ -242,6 +242,8 @@ class SparkTorch(Estimator):
         self._n_micro = 4 if n_micro is None else int(n_micro)
         sched = kwargs.pop("pipeline_schedule", None)
         self._pipeline_schedule = "gpipe" if sched is None else str(sched)
+        vs = kwargs.pop("virtual_stages", None)
+        self._virtual_stages = 1 if vs is None else int(vs)
         self._set(**kwargs)
 
     @keyword_only
@@ -261,6 +263,10 @@ class SparkTorch(Estimator):
             sched = kwargs.pop("pipeline_schedule")
             if sched is not None:
                 self._pipeline_schedule = str(sched)
+        if "virtual_stages" in kwargs:
+            vs = kwargs.pop("virtual_stages")
+            if vs is not None:
+                self._virtual_stages = int(vs)
         return self._set(**kwargs)
 
     # -- getters (torch_distributed.py:224-264 parity) ----------------------
@@ -348,6 +354,7 @@ class SparkTorch(Estimator):
                 device=self.getDevice(),
                 n_micro=self._n_micro,
                 pipeline_schedule=self._pipeline_schedule,
+                virtual_stages=getattr(self, "_virtual_stages", 1),
             )
         elif mode in ("hogwild", "async"):
             from sparktorch_tpu.train.hogwild import train_async

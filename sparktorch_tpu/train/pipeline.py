@@ -1700,6 +1700,80 @@ def make_pp_train_step(
         grads = jax.tree.map(lambda g: g / den_safe, grads)
         return loss, den_g, grads, jnp.zeros(())
 
+    def interleaved_eval_loss(params, x, y, w):
+        """Forward-only interleaved schedule: the validation loss on
+        the SAME (interleave-permuted) layer layout the train step
+        runs — only the forward half of the schedule tables fires
+        (the last forward entry lands at tick V*M + S - 2, so the
+        scan runs V*M + S - 1 ticks). Same mask/cond discipline as
+        ``interleaved_grads``."""
+        stage = jax.lax.axis_index(AXIS_PP)
+        b_local, s_len = x.shape
+        if b_local % n_micro != 0:
+            raise ValueError(
+                f"local batch {b_local} not divisible by n_micro={n_micro}"
+            )
+        mb = b_local // n_micro
+        micro_x = x.reshape(n_micro, mb, s_len)
+        micro_y = y.reshape((n_micro, mb) + y.shape[1:])
+        micro_w = w.reshape(n_micro, mb)
+        M = n_micro
+        fwd_ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def chunk_params(p, v):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, v * lps_i, lps_i, 0
+                ),
+                p["layers"],
+            )
+
+        def tick(carry, t):
+            fwd_ch, num, den = carry
+            vf = fv_tab[t, stage]
+            mf = fm_tab[t, stage]
+            fwd_valid = vf >= 0
+            vf_c = jnp.clip(vf, 0, V - 1)
+            mf_c = jnp.clip(mf, 0, M - 1)
+
+            def do_fwd():
+                h_in = jax.lax.cond(
+                    (vf_c == 0) & (stage == 0),
+                    lambda: embed(params, micro_x[mf_c]),
+                    lambda: fwd_ch,
+                )
+                h_out = stage_fn(chunk_params(params, vf_c), h_in)
+                n_, d_ = jax.lax.cond(
+                    (vf_c == V - 1) & (stage == S - 1),
+                    lambda: head_loss(params, h_out, micro_y[mf_c],
+                                      micro_w[mf_c]),
+                    lambda: (jnp.zeros(()), jnp.zeros(())),
+                )
+                return h_out, n_, d_
+
+            def skip_fwd():
+                z = jnp.zeros((mb, s_len, cfg.d_model), dt)
+                return z, jnp.zeros(()), jnp.zeros(())
+
+            h_out, n_, d_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
+            num = num + n_
+            den = den + d_
+            fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
+            return (fwd_next, num, den), None
+
+        init = (
+            jnp.zeros((mb, s_len, cfg.d_model), dt),
+            jnp.zeros(()), jnp.zeros(()),
+        )
+        # Every forward entry lands by tick V*M + S - 2 (the combined
+        # schedule's later ticks are backward-only).
+        (_, num, den), _ = jax.lax.scan(
+            tick, init, jnp.arange(V * M + S - 1)
+        )
+        num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
+        den_g = jax.lax.psum(den, (AXIS_PP, AXIS_DP))
+        return num_g / jnp.maximum(den_g, 1.0)
+
     def local_step(params, opt_state, x, y, w, key):
         dp_idx = jax.lax.axis_index(AXIS_DP)
 
@@ -1870,15 +1944,17 @@ def make_pp_train_step(
         aux objectives are excluded from the validation signal, like
         the DP eval)."""
         if V > 1:
-            # The eval path is the GPipe schedule, which walks each
-            # device's local stack in stage order — under the
-            # interleaved layout that would evaluate a SCRAMBLED layer
-            # order. Fail loudly until an interleaved eval exists.
-            raise ValueError(
-                "validation/eval is not supported with virtual_stages>1 "
-                "yet; train with validation_pct=0 and no early stopping "
-                "signal, or use virtual_stages=1"
+            # The GPipe eval walks each device's local stack in stage
+            # order, which would be SCRAMBLED under the interleaved
+            # layout — eval with the forward half of the interleaved
+            # schedule instead (same chunk walk as training).
+            eval_mapped = shard_map_compat(
+                interleaved_eval_loss,
+                mesh,
+                in_specs=(specs, x_spec, y_spec, P(AXIS_DP)),
+                out_specs=P(),
             )
+            return jax.jit(eval_mapped)
         eval_mapped = shard_map_compat(
             lambda p, x, y, w: schedule_loss(p, x, y, w)[1][1],
             mesh,
@@ -1899,8 +1975,7 @@ def make_pp_train_step(
                 out_specs=(specs, opt_specs, P(), P(), P(), P()),
             )
             cache["jitted"] = jax.jit(mapped, donate_argnums=(0, 1))
-            if V == 1:
-                cache["eval"] = _build_eval(specs)
+            cache["eval"] = _build_eval(specs)
 
     def memory_analysis(state: PipelineState, batch: DataBatch, key=None):
         """XLA's memory analysis of the compiled train step (temp
@@ -2110,13 +2185,6 @@ def train_distributed_pipeline(
             f"pp training uses cross entropy; got {spec.loss!r}"
         )
 
-    if virtual_stages and virtual_stages > 1 and validation_pct > 0:
-        raise ValueError(
-            "validation_pct is not supported with virtual_stages>1 "
-            "(the eval path would walk the interleave-permuted stack "
-            "in the wrong order); use virtual_stages=1 or "
-            "validation_pct=0"
-        )
     if pre_sharded:
         # ``data`` is a globally-sharded DataBatch (multi-host path:
         # per-process shards assembled by train_distributed_multihost

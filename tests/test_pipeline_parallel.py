@@ -1222,6 +1222,38 @@ def test_interleaved_validation():
                            schedule="1f1b", virtual_stages=2)
 
 
+def test_interleaved_validation_matches_plain():
+    """Validation under virtual_stages>1 evals with the forward half
+    of the interleaved schedule on the permuted stack — its val_loss
+    records must match the plain 1f1b run exactly (identical training
+    streams, identical eval math, different layer walk)."""
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.train.pipeline import train_distributed_pipeline
+
+    cfg = _cfg(n_layers=4)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (24, cfg.max_len + 1)).astype(
+        np.int32
+    )
+    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-2})
+
+    def val_losses(V):
+        mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+        r = train_distributed_pipeline(
+            spec, ids[:, :-1], labels=ids[:, 1:], mesh=mesh, iters=3,
+            n_micro=2, schedule="1f1b", virtual_stages=V,
+            validation_pct=0.25, seed=0,
+        )
+        return [m["val_loss"] for m in r.metrics
+                if m.get("val_loss") is not None]
+
+    v1 = val_losses(1)
+    v2 = val_losses(2)
+    assert len(v1) == 3 and len(v2) == 3
+    np.testing.assert_allclose(v2, v1, rtol=1e-5)
+
+
 def test_interleaved_checkpoint_layout_guard(tmp_path):
     """Checkpoints store the stack in the schedule's permuted order:
     resuming with a different virtual_stages must fail loudly, not
