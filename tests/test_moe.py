@@ -229,6 +229,12 @@ def test_moe_sp_ep_composition_parity():
                       seq_sharded=True, attn_impl="ring",
                       moe_group_size=16)
     np.testing.assert_allclose(l_sp, l_ref, rtol=3e-3)
+    # And every non-batch axis at once: tp slices heads/FFN columns on
+    # top of the sp ring and the ep dispatch.
+    l_all = _run_steps(MeshConfig(dp=1, tp=2, sp=2, ep=2), n_steps=5,
+                       seq_sharded=True, attn_impl="ring",
+                       moe_group_size=16)
+    np.testing.assert_allclose(l_all, l_ref, rtol=3e-3)
 
 
 def test_moe_tp_ep_composition_parity():
