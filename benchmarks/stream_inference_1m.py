@@ -48,6 +48,37 @@ def rss_gb() -> float:
     return 0.0
 
 
+def stall_watchdog_loop(get_fenced, is_streaming, timeout_s: float,
+                        on_stall, sleep_s: float = 10.0,
+                        clock=time.monotonic, sleep=time.sleep) -> None:
+    """Fire ``on_stall()`` when fenced progress freezes for
+    ``timeout_s`` while streaming is active. The round-5 wire stall:
+    a fence readback simply never returned (12+ minutes, process
+    alive, zero progress) and needed an operator kill — this loop is
+    that operator. The timer resets on ANY fenced progress and while
+    streaming is inactive — and "streaming" arms only at the FIRST
+    drained batch of a pass, so dataset gen, compile, the final
+    download AND the resume skip-scan (minutes of reader decode at
+    large offsets, zero fenced progress by design) can't
+    false-positive. Runs on a daemon
+    thread; during a real stall the main thread is BLOCKED inside the
+    dead fence, so the state it snapshots is quiescent. Injectable
+    clock/sleep for tests; returns when on_stall() returns (the real
+    on_stall execv's and never does)."""
+    last_rows, last_t = get_fenced(), clock()
+    while True:
+        sleep(sleep_s)
+        if not is_streaming():
+            last_rows, last_t = get_fenced(), clock()
+            continue
+        now_rows = get_fenced()
+        if now_rows != last_rows:
+            last_rows, last_t = now_rows, clock()
+        elif clock() - last_t > timeout_s:
+            on_stall()
+            return
+
+
 def ensure_dataset(path: str, rows: int) -> int:
     from sparktorch_tpu.inference import write_rows_parquet
 
@@ -111,6 +142,12 @@ def main() -> None:
         "RSS exceeds this — automates the mitigation for the tunnel "
         "client's upload-staging leak (~150 KB retained per uploaded "
         "row; 0 disables)",
+    )
+    ap.add_argument(
+        "--stall-timeout-s", type=float, default=600.0,
+        help="exec-restart when FENCED progress freezes this long mid-"
+        "stream (the tunnel wire can stall outright, leaving a fence "
+        "readback that never returns; 0 disables)",
     )
     args = ap.parse_args()
 
@@ -232,7 +269,15 @@ def main() -> None:
     # FIFO: consuming batch k's fence proves every batch <= k ran).
     fenced = [resume_start]
 
+    # Serializes every state mutation/persist between the main thread
+    # and the watchdog thread (concurrent writers to the same tmp file
+    # could publish truncated JSON and brick every later resume).
+    import threading
+
+    state_lock = threading.RLock()
+
     def snapshot(final: bool = False):
+      with state_lock:
         st["elapsed_s"] = base_elapsed + (time.perf_counter() - t_run0)
         persist = dict(st)
         if not final:
@@ -252,35 +297,58 @@ def main() -> None:
     # mid-pass restart can close the partial segment's accounting.
     cur_pass = {"start_rows": 0, "t0": 0.0}
 
+    def _do_restart(reason: str):
+        """Persist the fenced state (closing the partial pass segment
+        so passes still sum to n_rows, and stamping exec_ts so the
+        restart's wall stays in elapsed) and exec-restart THIS command
+        in place — same pid, same argv; the fresh process resumes
+        mid-pass from the state file."""
+        state_lock.acquire()  # held until execv (the process dies)
+        st["restarts"] = int(st.get("restarts", 0)) + 1
+        seg_rows = max(0, min(st["rows_done"], fenced[0])
+                       - cur_pass["start_rows"])
+        if seg_rows > 0:
+            st["pass_rows"].append(seg_rows)
+            st["pass_s"].append(
+                round(time.perf_counter() - cur_pass["t0"], 2)
+            )
+        st["exec_ts"] = time.time()
+        snapshot()
+        print(f"{reason} — exec-restarting at fenced row {fenced[0]}",
+              flush=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable,
+                 [sys.executable, os.path.abspath(__file__)]
+                 + sys.argv[1:])
+
     def maybe_restart():
-        """The automated leak mitigation: when host RSS crosses the
-        limit, persist the fenced state and exec-restart THIS command
-        in place (same pid, same argv) — the fresh process resumes
-        mid-pass from the state file; wall/elapsed carries across via
-        the state's elapsed accounting plus the exec_ts gap credit."""
+        """The automated leak mitigation (checked at the 30s save
+        cadence in drain)."""
         if args.rss_limit_gb and args.rss_limit_gb > 0:
             r = rss_gb()
             if r > args.rss_limit_gb:
-                st["restarts"] = int(st.get("restarts", 0)) + 1
-                # Close the partial pass segment (fenced rows only) so
-                # the final report's passes still sum to n_rows.
-                seg_rows = max(0, min(st["rows_done"], fenced[0])
-                               - cur_pass["start_rows"])
-                if seg_rows > 0:
-                    st["pass_rows"].append(seg_rows)
-                    st["pass_s"].append(
-                        round(time.perf_counter() - cur_pass["t0"], 2)
-                    )
-                st["exec_ts"] = time.time()
-                snapshot()
-                print(f"rss watchdog: {r:.1f}GB > {args.rss_limit_gb}GB — "
-                      f"exec-restarting at fenced row {fenced[0]} to shed "
-                      "the upload-staging leak", flush=True)
-                sys.stdout.flush()
-                sys.stderr.flush()
-                os.execv(sys.executable,
-                         [sys.executable, os.path.abspath(__file__)]
-                         + sys.argv[1:])
+                _do_restart(
+                    f"rss watchdog: {r:.1f}GB > {args.rss_limit_gb}GB "
+                    "(upload-staging leak)"
+                )
+
+    # The wire can STALL outright (a fence readback that never
+    # returns — observed 12+ minutes frozen); the main thread is stuck
+    # inside the dead RPC then, so the stall remedy runs on its own
+    # thread.
+    streaming = [False]
+    if args.stall_timeout_s and args.stall_timeout_s > 0:
+        threading.Thread(
+            target=stall_watchdog_loop,
+            args=(lambda: fenced[0], lambda: streaming[0],
+                  args.stall_timeout_s,
+                  lambda: _do_restart(
+                      f"stall watchdog: no fenced progress for "
+                      f"{args.stall_timeout_s:.0f}s (wire stall)"
+                  )),
+            daemon=True,
+        ).start()
 
     while st["rows_done"] < args.rows:
         pass_start_rows = st["rows_done"]
@@ -298,6 +366,9 @@ def main() -> None:
             # that works; it costs one round-trip per 1024 rows —
             # ~1-3% of the batch's 15 s of wire time).
             start = st["rows_done"]
+            streaming[0] = True  # first drain: fenced progress begins;
+            # arming earlier would count the resume skip-scan (minutes
+            # at large offsets) as a "stall"
             nonlocal_buf[0] = _acc(nonlocal_buf[0], out, start % args.rows)
             fence, pending_fence[0] = (
                 pending_fence[0],
@@ -325,9 +396,11 @@ def main() -> None:
             skip_rows=offset_in_pass, max_rows=want,
             device_outputs=True,
         )
+        streaming[0] = False
         dt_pass = time.perf_counter() - t_pass0
-        st["pass_rows"].append(st["rows_done"] - pass_start_rows)
-        st["pass_s"].append(round(dt_pass, 2))
+        with state_lock:
+            st["pass_rows"].append(st["rows_done"] - pass_start_rows)
+            st["pass_s"].append(round(dt_pass, 2))
         snapshot()
         print(f"pass segment: {stats['n_rows']} rows in {dt_pass:.1f}s "
               f"({stats['n_rows']/max(dt_pass,1e-9):.1f} rows/s) "
