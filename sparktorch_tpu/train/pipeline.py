@@ -311,19 +311,10 @@ def _ln(p, x, dt):
     return (xf * p["scale"] + p["bias"]).astype(dt)
 
 
-def _layer_forward(cfg: TransformerConfig, lp, h):
-    """One encoder layer on this device's head/column slice.
-
-    ``lp`` is the layer's param tree with ``qkv``/``proj``/``mlp``
-    kernels already SLICED over tp (shard_map did that); ln params and
-    output-side biases arrive replicated. Replicated output-side
-    biases are added AFTER :func:`_tp_reduce` (once, undivided): the
-    cotangent there is the full output cotangent on every slice, so
-    their gradients come out complete and tp-identical with no
-    reduction — adding a 1/tp-scaled bias inside the reduce instead
-    would silently shrink those gradients by tp (caught by the SGD
-    grad-parity test).
-    """
+def _attn_half(cfg: TransformerConfig, lp, h):
+    """ln_attn -> attention -> proj residual: the first half of
+    :func:`_layer_forward`, shared with the MoE layer path (whose FFN
+    half is an expert dispatch instead of the dense MLP)."""
     dt = cfg.compute_dtype
     a = _tp_enter(_ln(lp["ln_attn"], h, dt))
     qkv_k = lp["attn"]["qkv"]["kernel"].astype(dt)     # (d, 3, h_loc, hd)
@@ -348,9 +339,24 @@ def _layer_forward(cfg: TransformerConfig, lp, h):
         out = dense_attention(q, k, v, causal=cfg.causal)
     proj_k = lp["attn"]["proj"]["kernel"].astype(dt)   # (h_loc, hd, d)
     proj_b = lp["attn"]["proj"]["bias"].astype(dt)     # (d,) replicated
-    attn_out = _tp_reduce(jnp.einsum("bshf,hfd->bsd", out, proj_k)) + proj_b
-    x = h + attn_out
+    return h + _tp_reduce(jnp.einsum("bshf,hfd->bsd", out, proj_k)) + proj_b
 
+
+def _layer_forward(cfg: TransformerConfig, lp, h):
+    """One encoder layer on this device's head/column slice.
+
+    ``lp`` is the layer's param tree with ``qkv``/``proj``/``mlp``
+    kernels already SLICED over tp (shard_map did that); ln params and
+    output-side biases arrive replicated. Replicated output-side
+    biases are added AFTER :func:`_tp_reduce` (once, undivided): the
+    cotangent there is the full output cotangent on every slice, so
+    their gradients come out complete and tp-identical with no
+    reduction — adding a 1/tp-scaled bias inside the reduce instead
+    would silently shrink those gradients by tp (caught by the SGD
+    grad-parity test).
+    """
+    dt = cfg.compute_dtype
+    x = _attn_half(cfg, lp, h)
     m = _tp_enter(_ln(lp["ln_mlp"], x, dt))
     w1 = lp["mlp_in"]["kernel"].astype(dt)             # (d, ff_loc)
     b1 = lp["mlp_in"]["bias"].astype(dt)               # (ff_loc,)
@@ -364,27 +370,6 @@ def _moe_pattern(cfg: TransformerConfig):
     """Per-layer use_moe flags — delegates to the ONE schedule
     definition on the config (shared with the flax Transformer)."""
     return cfg.moe_pattern()
-
-
-class _AttnPart(nn.Module):
-    """The pre-FFN half of ``EncoderLayer`` (ln_attn -> attn residual
-    -> ln_mlp) as a standalone module with the SAME submodule names,
-    so it applies the same stacked param subtree — used by the ep>1
-    MoE path, which splits the layer so the expert FFN can run under
-    manual expert parallelism."""
-
-    config: TransformerConfig
-
-    @nn.compact
-    def __call__(self, x):
-        from sparktorch_tpu.models.transformer import MultiHeadAttention
-
-        cfg = self.config
-        dt = cfg.compute_dtype
-        h = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
-        x = x + MultiHeadAttention(cfg, name="attn")(h)
-        h = nn.LayerNorm(dtype=dt, name="ln_mlp")(x)
-        return x, h
 
 
 def _moe_groups(cfg: TransformerConfig, n: int) -> Tuple[int, int]:
@@ -906,7 +891,10 @@ def make_pp_train_step(
     """Build the jitted pipelined train step over ``mesh`` (dp x pp x
     tp x sp x ep; other axes must be 1 for this trainer). sp > 1
     shards the sequence dim and requires ``attn_impl='ring'`` (the
-    ring rides the same shard_map as the schedule).
+    ring rides the same shard_map as the schedule). MoE stacks
+    compose with sp when ``moe_group_size`` divides the per-shard
+    sequence length (routing groups then tile inside sequence shards,
+    keeping sp a pure layout choice), and with ep on the same mesh.
 
     ``head``: ``'lm'`` (next-token CE over the vocab, causal) or
     ``'classifier'`` (BERT-style pooler + class CE — the config-4
@@ -981,11 +969,11 @@ def make_pp_train_step(
     # stays the GSPMD trainer's ep axis.
     pattern = _moe_pattern(cfg)
     has_moe = any(pattern)
-    if V > 1 and (has_moe or SP > 1):
+    if V > 1 and has_moe:
         raise ValueError(
             "virtual_stages>1 (interleaved 1F1B) currently supports "
-            "dense stacks with sp=1 (tp composes); MoE and sp "
-            "compose with the plain schedules"
+            "dense stacks (tp and sp compose); MoE composes with the "
+            "plain schedules"
         )
     if E > 1 and not has_moe:
         raise ValueError(
@@ -998,12 +986,13 @@ def make_pp_train_step(
                 "pp x tp with MoE layers is not supported; use tp=1 "
                 "(experts shard over the ep axis instead)"
             )
-        if SP > 1:
-            raise ValueError(
-                "pp x sp with MoE layers is not supported: routing is "
-                "token-local but the aux/capacity accounting assumes "
-                "the full sequence per shard; use sp=1 with MoE"
-            )
+        # sp>1 composes with MoE when moe_group_size tiles the
+        # per-shard sequence (checked at trace time in stage_fn_moe,
+        # where the shard's seq length is known): routing groups then
+        # sit INSIDE sequence-shard rows, so the sp>1 group partition
+        # is exactly the sp=1 partition and sp stays a pure layout
+        # choice. Each member's local aux is its per-shard share of
+        # the global (sum over sp / SP) load-balance objective.
         if E > 1 and cfg.n_experts % E != 0:
             raise ValueError(
                 f"n_experts={cfg.n_experts} not divisible by ep={E}"
@@ -1033,37 +1022,20 @@ def make_pp_train_step(
         return h
 
     if has_moe:
-        from sparktorch_tpu.train.step import _moe_drop_counts
-
-        moe_layer = EncoderLayer(cfg, use_moe=True)
-        attn_part = _AttnPart(cfg)
-
         def moe_apply(lp, h, token_w):
-            if E > 1:
-                # ep>1: split the layer so the expert FFN runs under
-                # manual expert parallelism (experts pre-sliced over
-                # the ep axis by shard_map; one psum combines).
-                x_mid, h_ln = attn_part.apply(
-                    {"params": {k: lp[k]
-                                for k in ("ln_attn", "attn", "ln_mlp")}},
-                    h,
-                )
-                moe_out, aux, dropped, routed = _moe_ffn_ep_dispatch(
-                    cfg, lp["moe"], h_ln, token_w, E
-                )
-                return x_mid + moe_out, aux, dropped, routed
-            out, sown = moe_layer.apply(
-                {"params": lp}, h, token_w,
-                mutable=["losses", "moe_metrics"],
+            # Split the layer: the attention half is the SAME manual
+            # math as the dense layers (so its ring branch works
+            # under sp — a flax-module attention here would silently
+            # fall back to block-local dense inside the Manual-axes
+            # shard_map), and the expert FFN runs the layout picked by
+            # moe_ep_dispatch (no collectives at ep=1; experts
+            # pre-sliced over the ep axis by shard_map otherwise).
+            x_mid = _attn_half(cfg, lp, h)
+            h_ln = _ln(lp["ln_mlp"], x_mid, dt)
+            moe_out, aux, dropped, routed = _moe_ffn_ep_dispatch(
+                cfg, lp["moe"], h_ln, token_w, E
             )
-            aux = jnp.zeros((), jnp.float32)
-            for leaf in jax.tree.leaves(sown.get("losses", {})):
-                aux = aux + jnp.sum(leaf).astype(jnp.float32)
-            counts = _moe_drop_counts(sown.get("moe_metrics"))
-            dropped, routed = counts if counts is not None else (
-                jnp.zeros(()), jnp.zeros(())
-            )
-            return out, aux, dropped, routed
+            return x_mid + moe_out, aux, dropped, routed
 
         if cfg.remat:
             moe_apply = jax.checkpoint(moe_apply)
@@ -1071,6 +1043,19 @@ def make_pp_train_step(
         def stage_fn_moe(params, h, token_w):
             """Unrolled stage walk over the per-stage pattern, picking
             each layer's params from its kind's pp-sharded stack."""
+            if SP > 1 and h.shape[1] % max(1, cfg.moe_group_size):
+                # Trace-time contract: groups must tile the per-shard
+                # sequence rows so every group lives inside ONE sp
+                # shard and both sp=1 and sp>1 pick g=moe_group_size —
+                # the condition under which sp is a pure layout choice
+                # for routing/capacity/aux (any other g silently
+                # changes the group partition vs sp=1).
+                raise ValueError(
+                    f"pp x sp with MoE needs moe_group_size "
+                    f"({cfg.moe_group_size}) dividing the per-shard "
+                    f"sequence length ({h.shape[1]}); set "
+                    "moe_group_size to a divisor of seq/sp"
+                )
             aux = jnp.zeros((), jnp.float32)
             dropped = jnp.zeros((), jnp.float32)
             routed = jnp.zeros((), jnp.float32)
@@ -1229,12 +1214,26 @@ def make_pp_train_step(
                 # Sum over stages/layers (psum pp — stages hold
                 # disjoint MoE layers), mean over microbatches and dp
                 # shards: the pipelined analog of the GSPMD trainer's
-                # batch-mean sown aux.
-                aux_g = jax.lax.psum(aux, (AXIS_PP, AXIS_DP))
+                # batch-mean sown aux. With sp>1 each member's local
+                # aux covers its DISJOINT sequence-shard groups:
+                # _sp_reduce (psum fwd / identity bwd) globalizes the
+                # value while each member's backward keeps its honest
+                # per-shard share (completed by the trainer's sp grad
+                # psum), and /SP converts the sp-sum of local group
+                # means into the global group mean.
+                sp_axes = (AXIS_SP,) if SP > 1 else ()
+                aux_g = jax.lax.psum(
+                    _sp_reduce(aux) if SP > 1 else aux,
+                    (AXIS_PP, AXIS_DP),
+                )
                 dp_n = jax.lax.axis_size(AXIS_DP)
-                loss = loss + aux_g / (n_micro * dp_n)
-                dropped_g = jax.lax.psum(dropped, (AXIS_PP, AXIS_DP))
-                routed_g = jax.lax.psum(routed, (AXIS_PP, AXIS_DP))
+                loss = loss + aux_g / (n_micro * dp_n * SP)
+                dropped_g = jax.lax.psum(
+                    dropped, (AXIS_PP, AXIS_DP) + sp_axes
+                )
+                routed_g = jax.lax.psum(
+                    routed, (AXIS_PP, AXIS_DP) + sp_axes
+                )
                 drop_fraction = dropped_g / jnp.maximum(routed_g, 1.0)
             else:
                 drop_fraction = jnp.zeros(())
@@ -1296,7 +1295,10 @@ def make_pp_train_step(
         den_g = jax.lax.psum(jnp.sum(w), AXIS_DP)
         den_safe = jnp.maximum(den_g, 1.0)
         dp_n = jax.lax.axis_size(AXIS_DP)
-        aux_seed = den_safe / (n_micro * dp_n)
+        # With sp>1 each member's local aux is a per-shard share of
+        # the global aux = (sum over sp of local) / SP, so its
+        # gradient weight carries an extra 1/SP.
+        aux_seed = den_safe / (n_micro * dp_n * SP)
 
         def stage_out(p, h_in, tw):
             """(h_out, aux, dropped, routed) — zeros for dense."""
@@ -1312,14 +1314,17 @@ def make_pp_train_step(
             execute UNCONDITIONALLY: a collective inside a lax.cond
             whose predicate varies over pp deadlocks/miscomputes (the
             sp members of a skipping stage never enter the exchange).
-            Masking moves to the VJP seeds instead of branch choice."""
-            h_out, aux, _, _ = stage_out(p, h_in, tw)
+            Masking moves to the VJP seeds instead of branch choice.
+            Returns the MoE drop metrics too — the forward sub-tick
+            accumulates them (validity-masked); the backward vjp runs
+            over the first three outputs only."""
+            h_out, aux, dr_, rt_ = stage_out(p, h_in, tw)
             num = jax.lax.cond(
                 stage == S - 1,
                 lambda: head_loss(p, h_out, micro_y[mi], micro_w[mi])[0],
                 lambda: jnp.zeros(()),
             )
-            return h_out, num, aux
+            return h_out, num, aux, dr_, rt_
 
         def last_outs(p, h_in, yy, ww, tw):
             """(num, aux) of the last stage — the two differentiated
@@ -1463,10 +1468,17 @@ def make_pp_train_step(
                 lambda: embed(params, micro_x[mi_f]),
                 lambda: fwd_ch,
             )
-            h_out, n_, a_ = tick_outs(params, h_in, tw_of(micro_w[mi_f]),
-                                      mi_f)
+            h_out, n_, a_, dr_, rt_ = tick_outs(
+                params, h_in, tw_of(micro_w[mi_f]), mi_f
+            )
             num = num + fv * n_
             aux = aux + fv * a_
+            # Bubble ticks route a REAL microbatch's token weights
+            # over garbage activations (the body must run for its
+            # collectives): validity-mask the drop metrics here, where
+            # the GPipe scan masks via zeroed token weights instead.
+            dr = dr + fv * dr_
+            rt = rt + fv * rt_
             ring = jnp.where(
                 fwd_valid,
                 jax.lax.dynamic_update_slice(
@@ -1484,7 +1496,8 @@ def make_pp_train_step(
             )
             tw_b = tw_of(micro_w[mi_b])
             _, pull = jax.vjp(
-                lambda p, h: tick_outs(p, h, tw_b, mi_b), params, h_saved
+                lambda p, h: tick_outs(p, h, tw_b, mi_b)[:3],
+                params, h_saved,
             )
             # Seeds do the masking (pullbacks are linear, so zero seeds
             # yield zero cotangents): the last stage's h_out cotangent
@@ -1533,11 +1546,13 @@ def make_pp_train_step(
         if has_moe:
             # Same accounting as the GPipe schedule_loss: stages hold
             # disjoint MoE layers (psum over pp), mean over
-            # microbatches and dp shards.
-            aux_g = jax.lax.psum(aux, (AXIS_PP, AXIS_DP))
-            loss = loss + aux_g / (n_micro * dp_n)
-            dr_g = jax.lax.psum(dr, (AXIS_PP, AXIS_DP))
-            rt_g = jax.lax.psum(rt, (AXIS_PP, AXIS_DP))
+            # microbatches and dp shards; sp members hold disjoint
+            # sequence-shard groups (sum over sp / SP).
+            sp_axes = (AXIS_SP,) if SP > 1 else ()
+            aux_g = jax.lax.psum(aux, (AXIS_PP, AXIS_DP) + sp_axes)
+            loss = loss + aux_g / (n_micro * dp_n * SP)
+            dr_g = jax.lax.psum(dr, (AXIS_PP, AXIS_DP) + sp_axes)
+            rt_g = jax.lax.psum(rt, (AXIS_PP, AXIS_DP) + sp_axes)
             drop_fraction = dr_g / jnp.maximum(rt_g, 1.0)
         else:
             drop_fraction = jnp.zeros(())
@@ -1579,8 +1594,6 @@ def make_pp_train_step(
         M = n_micro
         fwd_ring = [(i, (i + 1) % S) for i in range(S)]
         bwd_ring = [(i, (i - 1) % S) for i in range(S)]
-        den_g = jax.lax.psum(jnp.sum(w), AXIS_DP)
-        den_safe = jnp.maximum(den_g, 1.0)
 
         def chunk_params(p, v):
             return jax.tree.map(
@@ -1685,6 +1698,82 @@ def make_pp_train_step(
             bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
             return (ring, fwd_next, bwd_next, grads, num), None
 
+        def tick_masked(carry, t):
+            """The sp>1 interleaved tick: same discipline as the plain
+            1F1B ``tick_masked`` — the chunk body (whose ring
+            attention ppermutes over sp must execute on EVERY tick;
+            a collective under a pp-varying lax.cond deadlocks or
+            miscomputes) and one unified per-tick vjp run
+            unconditionally, with validity masking the accumulators
+            and the vjp seeds. chunk_outs' inner head cond is safe:
+            its predicate (vf==V-1 & stage==S-1) is uniform across sp
+            members, and invalid ticks clip vf to 0 != V-1 (V>=2), so
+            the head never fires on garbage."""
+            ring, fwd_ch, bwd_ch, grads, num = carry
+
+            vf = fv_tab[t, stage]
+            mf = fm_tab[t, stage]
+            fwd_valid = vf >= 0
+            vf_c = jnp.clip(vf, 0, V - 1)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            fv = fwd_valid.astype(jnp.float32)
+
+            # embed has no collectives: the cond is safe (and on
+            # invalid ticks h_in is garbage that nothing consumes —
+            # the ring only stores it under fwd_valid).
+            h_in = jax.lax.cond(
+                (vf_c == 0) & (stage == 0),
+                lambda: embed(params, micro_x[mf_c]),
+                lambda: fwd_ch,
+            )
+            h_out, n_ = chunk_outs(params, h_in, vf_c, mf_c)
+            num = num + fv * n_
+            ring = jnp.where(
+                fwd_valid,
+                jax.lax.dynamic_update_slice(
+                    ring, h_in[None, None], (vf_c, mf_c % RV, 0, 0, 0)
+                ),
+                ring,
+            )
+
+            vb = bv_tab[t, stage]
+            mb_i = bm_tab[t, stage]
+            bwd_valid = vb >= 0
+            vb_c = jnp.clip(vb, 0, V - 1)
+            mb_c = jnp.clip(mb_i, 0, M - 1)
+            h_saved = jax.lax.dynamic_slice(
+                ring, (vb_c, mb_c % RV, 0, 0, 0),
+                (1, 1, mb, s_len, cfg.d_model),
+            )[0, 0]
+            is_last = (vb_c == V - 1) & (stage == S - 1)
+            _, pull = jax.vjp(
+                lambda p, h: chunk_outs(p, h, vb_c, mb_c),
+                params, h_saved,
+            )
+            bv = bwd_valid.astype(jnp.float32)
+            seed_h = (
+                jnp.where(bwd_valid & ~is_last, 1.0, 0.0).astype(dt)
+                * bwd_ch
+            )
+            ct_params, ct_h = pull((seed_h, bv))
+
+            def embed_grads():
+                _, epull = jax.vjp(
+                    lambda p: embed(p, micro_x[mb_c]), params
+                )
+                return epull(ct_h)[0]
+
+            ct_params = jax.lax.cond(
+                (vb_c == 0) & (stage == 0),
+                lambda: jax.tree.map(jnp.add, ct_params, embed_grads()),
+                lambda: ct_params,
+            )
+            grads = jax.tree.map(jnp.add, grads, ct_params)
+
+            fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
+            bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
+            return (ring, fwd_next, bwd_next, grads, num), None
+
         init = (
             jnp.zeros((V, RV, mb, s_len, cfg.d_model), dt),
             jnp.zeros((mb, s_len, cfg.d_model), dt),
@@ -1693,9 +1782,24 @@ def make_pp_train_step(
             jnp.zeros(()),
         )
         (_, _, _, grads, num), _ = jax.lax.scan(
-            tick, init, jnp.arange(T_ticks)
+            tick_masked if SP > 1 else tick, init, jnp.arange(T_ticks)
         )
         num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
+        # den is schedule-independent, but its dp psum must NOT float
+        # freely against the scan's collectives: the CPU backend's
+        # thunk executor runs independent collectives in arbitrary
+        # per-device order, and a cross-device inversion (one device
+        # parked in this all-reduce while its dp partner waits inside
+        # a scan ppermute rendezvous) deadlocks on a starved thread
+        # pool — observed on the 8-virtual-device test rig, second
+        # step. Plain 1F1B is naturally immune (its aux_seed makes
+        # the scan consume den); here an optimization_barrier ties
+        # den's input to num_g, pinning the psum strictly after the
+        # scan on every device at zero math cost (a 0*num_g term
+        # could be algebraically simplified away).
+        w_dep = jax.lax.optimization_barrier((jnp.sum(w), num_g))[0]
+        den_g = jax.lax.psum(w_dep, AXIS_DP)
+        den_safe = jnp.maximum(den_g, 1.0)
         loss = num_g / den_safe
         grads = jax.tree.map(lambda g: g / den_safe, grads)
         return loss, den_g, grads, jnp.zeros(())
@@ -1755,7 +1859,18 @@ def make_pp_train_step(
                 z = jnp.zeros((mb, s_len, cfg.d_model), dt)
                 return z, jnp.zeros(()), jnp.zeros(())
 
-            h_out, n_, d_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
+            if SP > 1:
+                # Masked-tick discipline (see tick_masked in
+                # interleaved_grads): the chunk body's ring-attention
+                # collectives must run every tick — do_fwd runs
+                # UNCONDITIONALLY (its inner embed/head conds are
+                # sp-uniform and never fire on clipped garbage) and
+                # validity masks the accumulators instead.
+                h_out, n_, d_ = do_fwd()
+                fvv = fwd_valid.astype(jnp.float32)
+                n_, d_ = fvv * n_, fvv * d_
+            else:
+                h_out, n_, d_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
             num = num + n_
             den = den + d_
             fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
@@ -1839,15 +1954,15 @@ def make_pp_train_step(
             # leaves are ep-SHARDED and need no ep reduction).
             # With sp>1 each member trained on its SEQUENCE shard, so
             # every param grad is a per-shard share: sp joins dp in
-            # the data axes every reduction sums over (MoE is rejected
-            # with sp, so the moe rule keeps plain dp).
+            # the data axes every reduction sums over — MoE leaves
+            # included (their routing groups partition over sp too).
             data_axes = (AXIS_DP,) + ((AXIS_SP,) if SP > 1 else ())
 
             def _reduce_moe(path, g):
                 names = _path_names(path)
                 if E > 1 and "router" in names:
-                    return jax.lax.psum(g, (AXIS_DP, AXIS_EP))
-                return jax.lax.psum(g, AXIS_DP)
+                    return jax.lax.psum(g, data_axes + (AXIS_EP,))
+                return jax.lax.psum(g, data_axes)
 
             from jax.tree_util import tree_map_with_path
 
@@ -1887,10 +2002,12 @@ def make_pp_train_step(
             def _sq_moe(path, g):
                 names = _path_names(path)
                 # Expert leaves are distinct per (pp, ep) shard; the
-                # rest of the MoE layer is ep-replicated. (tp is
-                # rejected with MoE, so no tp term here.)
-                w_ = (1.0 / S_dp if names[-1] in _MOE_EXPERT_LEAVES
-                      else 1.0 / (S_dp * E_ax))
+                # rest of the MoE layer is ep-replicated; everything
+                # is sp-replicated post-reduction. (tp is rejected
+                # with MoE, so no tp term here.)
+                w_ = (1.0 / (S_dp * SP_ax)
+                      if names[-1] in _MOE_EXPERT_LEAVES
+                      else 1.0 / (S_dp * E_ax * SP_ax))
                 return jnp.sum(jnp.square(g)) * w_
 
             def _sq_layers(path, g):
@@ -2016,6 +2133,16 @@ def make_pp_train_step(
         new_params, new_opt, loss, drop, grad_norm, examples = cache[
             "jitted"
         ](state.params, state.opt_state, batch.x, batch.y, batch.w, key)
+        if jax.default_backend() == "cpu":
+            # The in-process CPU collectives runtime keys its
+            # rendezvous on a run id that COLLIDES across overlapping
+            # launches of the same executable; donation orders buffer
+            # reuse but not execution tails, so back-to-back steps can
+            # overlap and flakily mix rendezvous (observed as a 9th
+            # participant at an 8-thread collective permute, or a
+            # cross-collective deadlock). The virtual-device test rig
+            # serializes executions instead; real TPU stays async.
+            jax.block_until_ready((new_params, new_opt, loss))
         new_state = PipelineState(step=state.step + K, params=new_params,
                                   opt_state=new_opt)
         cache["last_step_arr"] = new_state.step
