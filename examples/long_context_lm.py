@@ -6,7 +6,7 @@ path: the sequence axis is sharded across chips, K/V blocks rotate on
 the ICI ring, and max context scales linearly with chips.
 
 Run on CPU for a demo world:
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_concurrency_optimized_scheduler=false" \
   JAX_PLATFORMS=cpu python examples/long_context_lm.py
 """
 

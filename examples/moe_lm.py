@@ -7,7 +7,7 @@ load-balance loss joins the objective automatically (sown into the
 ``losses`` collection, picked up by the sharded trainer).
 
 Run on CPU for a demo world:
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_concurrency_optimized_scheduler=false" \
   JAX_PLATFORMS=cpu python examples/moe_lm.py
 """
 

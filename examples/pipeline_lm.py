@@ -7,7 +7,7 @@ stream in behind them, so all stages stay busy outside the (S-1)
 bubble ticks.
 
 Run on CPU for a demo world:
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_concurrency_optimized_scheduler=false" \
   JAX_PLATFORMS=cpu python examples/pipeline_lm.py
 """
 

@@ -7,7 +7,7 @@ through the device (the copy of chunk i+1 rides under chunk i's fused
 train steps), so HBM holds only two chunks at a time.
 
 Run on CPU for a demo world:
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8 --xla_cpu_enable_concurrency_optimized_scheduler=false" \
   JAX_PLATFORMS=cpu python examples/streaming_large_dataset.py
 """
 

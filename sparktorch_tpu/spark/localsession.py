@@ -331,6 +331,11 @@ def _executor_env(n_devices: int = 1) -> Dict[str, str]:
     env = dict(os.environ)
     flags = env.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    if "--xla_cpu_enable_concurrency_optimized_scheduler" not in flags:
+        # Program-order thunk scheduling on the virtual-device rig —
+        # the concurrent scheduler flakily mixes same-shape collective
+        # rendezvous of one launch (see tests/conftest.py).
+        flags += " --xla_cpu_enable_concurrency_optimized_scheduler=false"
     env["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count={n_devices}"
     env["JAX_PLATFORMS"] = "cpu"
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -14,7 +14,17 @@ This must happen before any test initializes a JAX backend.
 import os
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # The CPU thunk executor's concurrency-optimized scheduler runs
+    # independent collectives of ONE launch concurrently, but the
+    # in-process rendezvous keys every collective of an executable
+    # with the same op_id — two overlapping same-shape collectives
+    # mix rendezvous and flakily deadlock (or crash with a
+    # 9th-of-8-participants check) on manual-collective-dense
+    # programs like the 1F1B tick. Program-order scheduling removes
+    # the hazard on the virtual-device rig; real TPU is unaffected.
+    + " --xla_cpu_enable_concurrency_optimized_scheduler=false"
 )
 
 import jax
