@@ -318,9 +318,19 @@ def main() -> None:
               flush=True)
         sys.stdout.flush()
         sys.stderr.flush()
-        os.execv(sys.executable,
-                 [sys.executable, os.path.abspath(__file__)]
-                 + sys.argv[1:])
+        try:
+            os.execv(sys.executable,
+                     [sys.executable, os.path.abspath(__file__)]
+                     + sys.argv[1:])
+        except OSError as exc:
+            # A failed execv must not strand state_lock with this
+            # (possibly watchdog-thread) caller — the main thread
+            # would hang at its next snapshot(). The fenced state was
+            # just persisted, so a hard exit keeps the crash-safe
+            # contract: rerunning the command resumes from the fence.
+            print(f"exec-restart FAILED ({exc}); exiting for external "
+                  "resume from the persisted state", flush=True)
+            os._exit(17)
 
     def maybe_restart():
         """The automated leak mitigation (checked at the 30s save

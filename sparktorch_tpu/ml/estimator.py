@@ -140,6 +140,13 @@ class SparkTorchModel(Model):
         inp = self.getInputCol()
         out_col = self.getPredictionCol()
         x = df.column_matrix(inp)
+        if x.shape[0] == 0:
+            # Zero-row frame: the reference's row-wise UDF simply
+            # never fires (torch_distributed.py:122-127) — emit an
+            # empty prediction column without touching the model,
+            # whose input width cannot be inferred from no rows.
+            dtype = object if self.getUseVectorOut() else np.float64
+            return df.with_column(out_col, np.empty((0,), dtype=dtype))
         preds = self._predict_matrix(x)
 
         if self.getUseVectorOut():

@@ -505,12 +505,16 @@ def train_async(
         shuffle_rng = np.random.default_rng(seed + 1)
 
         for round_idx in range(max(1, partition_shuffles)):
-            # Round 0 shuffles too when minibatch sampling is on —
-            # sample_minibatch's block sampling needs random resident
-            # order (cheap here: a host-side permutation pre-upload).
-            if round_idx > 0 or (mini_batch and mini_batch > 0):
-                perm = shuffle_rng.permutation(x.shape[0])
-                x, y, w = x[perm], y[perm], w[perm]  # hogwild.py:161-177
+            # EVERY round shuffles, round 0 included: the reference's
+            # _fit always repartition()s before training
+            # (torch_distributed.py:288-289), redistributing rows
+            # across partitions — without that, a label-sorted input
+            # becomes single-class workers and async training can
+            # collapse to whichever class pushed last (observed as
+            # chance accuracy, race-dependent). Minibatch block
+            # sampling needs the random resident order anyway.
+            perm = shuffle_rng.permutation(x.shape[0])
+            x, y, w = x[perm], y[perm], w[perm]  # hogwild.py:161-177
             xs = np.array_split(x, n_workers)
             ys = np.array_split(y, n_workers)
             ws = np.array_split(w, n_workers)

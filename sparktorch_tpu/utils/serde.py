@@ -84,6 +84,17 @@ OPTIMIZER_REGISTRY: dict[str, Callable[..., optax.GradientTransformation]] = {
     "Adagrad": optax.adagrad,
 }
 
+# torch.optim ctor default lrs (the reference binds the torch class
+# with whatever kwargs the user gave — util.py:204-208 — so `Adam`
+# with no params trains at torch's default 1e-3; optax ctors take
+# learning_rate positionally and would TypeError instead). Only names
+# that exist in torch.optim get a default — optax-only optimizers
+# (lamb, lion) keep the loud missing-lr error.
+_TORCH_DEFAULT_LR: dict[str, float] = {
+    "adam": 1e-3, "Adam": 1e-3, "adamw": 1e-3, "AdamW": 1e-3,
+    "rmsprop": 1e-2, "RMSprop": 1e-2, "adagrad": 1e-2, "Adagrad": 1e-2,
+}
+
 
 def resolve_optimizer(
     optimizer: Union[str, Callable, optax.GradientTransformation, None],
@@ -107,6 +118,8 @@ def resolve_optimizer(
             raise ValueError(
                 f"Unknown optimizer {optimizer!r}; known: {sorted(OPTIMIZER_REGISTRY)}"
             ) from None
+        if optimizer in _TORCH_DEFAULT_LR:
+            params.setdefault("learning_rate", _TORCH_DEFAULT_LR[optimizer])
         return ctor(**params)
     # A callable ctor (e.g. optax.adam itself, or a user factory).
     return optimizer(**params)

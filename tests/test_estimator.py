@@ -192,6 +192,24 @@ def test_inference_roundtrip(data, lazy_model):
     np.testing.assert_allclose(p1, p2, rtol=1e-6)
 
 
+def test_transform_zero_rows(data, general_model):
+    """Regression (round-5 verify drive): a zero-row frame used to
+    crash (the model's input width cannot be inferred from no rows).
+    The reference's row-wise UDF trivially never fires on an empty
+    frame (torch_distributed.py:122-127) — transform must emit an
+    empty prediction column, in both output modes."""
+    stm = SparkTorch(
+        inputCol="features", labelCol="label",
+        predictionCol="predictions", torchObj=general_model, iters=3,
+    )
+    model = stm.fit(data)
+    out = model.transform({"features": []})
+    assert len(out["predictions"]) == 0
+    model.set(model.useVectorOut, True)
+    out_v = model.transform({"features": []})
+    assert len(out_v["predictions"]) == 0
+
+
 def test_invalid_mode_rejected(data, general_model):
     # Unknown mode strings must fail fast at fit() time. (The valid
     # async path itself is covered in test_hogwild.py.)

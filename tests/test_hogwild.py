@@ -88,6 +88,39 @@ def test_hogwild_local_loss_decreases(payload):
     assert after < before * 0.8, (before, after)
 
 
+def test_hogwild_sorted_input_no_minibatch_trains():
+    """Regression (round-5 verify drive): a LABEL-SORTED input with
+    full-batch workers used to split contiguously into single-class
+    shards — async training then collapsed to whichever class pushed
+    last (chance accuracy, race-dependent). train_async now shuffles
+    round 0 too, like the reference's unconditional repartition before
+    training (torch_distributed.py:288-289)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    dim = 10
+    x = np.concatenate([
+        rng.normal(0.0, 1.0, (100, dim)),
+        rng.normal(2.0, 1.0, (100, dim)),
+    ]).astype(np.float32)             # sorted: class 0 rows, then class 1
+    y = np.concatenate([np.zeros(100), np.ones(100)]).astype(np.float32)
+    payload = serialize_torch_obj(
+        ClassificationNet(n_classes=2), criterion="cross_entropy",
+        optimizer="adam", optimizer_params={"lr": 5e-3}, input_shape=(dim,),
+    )
+    result = train_async(payload, x, labels=y, iters=15, partitions=2,
+                         seed=0)    # NO mini_batch: the failing config
+    spec = deserialize_model(payload)
+    module = spec.make_module()
+    preds = np.argmax(
+        np.asarray(module.apply({"params": result.params}, jnp.asarray(x))),
+        axis=1,
+    )
+    acc = float((preds == y).mean())
+    assert acc > 0.9, acc
+
+
 def test_hogwild_http_wire(payload):
     # Full HTTP path: pull / push / losses / liveness over a real
     # socket (the reference's Flask equivalent, server.py:89-147).
