@@ -628,6 +628,27 @@ def interleave_stack_permutation(n_layers: int, S: int, V: int) -> np.ndarray:
     return np.asarray(order)
 
 
+def apply_interleave_permutation(pparams, cfg: TransformerConfig,
+                                 S: int, V: int, inverse: bool = False):
+    """Permute the stacked layer trees into (``inverse=False``) or
+    back out of (``inverse=True``) the interleaved layout. The dense
+    and MoE stacks permute INDEPENDENTLY: with a per-chunk-uniform
+    pattern (enforced by ``make_pp_train_step``) each chunk holds a
+    fixed count of each kind, so each stack's chunk rows are
+    contiguous and reorder with that stack's own interleave
+    permutation."""
+    pattern = _moe_pattern(cfg)
+    out = dict(pparams)
+    for key, count in (("layers", pattern.count(False)),
+                       ("layers_moe", pattern.count(True))):
+        if key in out and count:
+            p = interleave_stack_permutation(count, S, V)
+            if inverse:
+                p = np.argsort(p)
+            out[key] = jax.tree.map(lambda a, p=p: a[p], out[key])
+    return out
+
+
 def _interleaved_schedule(S: int, V: int, M: int):
     """Host-side static schedule for interleaved 1F1B on a global
     combined-tick clock. Microbatches advance in groups of S per chunk
@@ -970,11 +991,20 @@ def make_pp_train_step(
     pattern = _moe_pattern(cfg)
     has_moe = any(pattern)
     if V > 1 and has_moe:
-        raise ValueError(
-            "virtual_stages>1 (interleaved 1F1B) currently supports "
-            "dense stacks (tp and sp compose); MoE composes with the "
-            "plain schedules"
-        )
+        # Interleaved chunks are the schedule's unit: every one of the
+        # S*V virtual stages must hold the same dense/MoE sequence so
+        # (a) the per-kind stacks slice uniformly per chunk and (b)
+        # the interleave permutation applies per stack.
+        lps_c = cfg.n_layers // (S * V)
+        chunk_patterns = [pattern[j * lps_c:(j + 1) * lps_c]
+                          for j in range(S * V)]
+        if any(cp != chunk_patterns[0] for cp in chunk_patterns):
+            raise ValueError(
+                f"interleaved 1F1B with MoE needs the dense/MoE "
+                f"pattern {pattern} uniform across all pp*virtual_"
+                f"stages={S * V} chunks; choose moe_every/n_layers "
+                "accordingly"
+            )
     if E > 1 and not has_moe:
         raise ValueError(
             "mesh ep>1 needs MoE layers (n_experts>0) — there are no "
@@ -987,8 +1017,9 @@ def make_pp_train_step(
                 "(experts shard over the ep axis instead)"
             )
         # sp>1 composes with MoE when moe_group_size tiles the
-        # per-shard sequence (checked at trace time in stage_fn_moe,
-        # where the shard's seq length is known): routing groups then
+        # per-shard sequence (checked at trace time in moe_apply —
+        # reached from every walk — where the shard's seq length is
+        # known): routing groups then
         # sit INSIDE sequence-shard rows, so the sp>1 group partition
         # is exactly the sp=1 partition and sp stays a pure layout
         # choice. Each member's local aux is its per-shard share of
@@ -1030,19 +1061,6 @@ def make_pp_train_step(
             # shard_map), and the expert FFN runs the layout picked by
             # moe_ep_dispatch (no collectives at ep=1; experts
             # pre-sliced over the ep axis by shard_map otherwise).
-            x_mid = _attn_half(cfg, lp, h)
-            h_ln = _ln(lp["ln_mlp"], x_mid, dt)
-            moe_out, aux, dropped, routed = _moe_ffn_ep_dispatch(
-                cfg, lp["moe"], h_ln, token_w, E
-            )
-            return x_mid + moe_out, aux, dropped, routed
-
-        if cfg.remat:
-            moe_apply = jax.checkpoint(moe_apply)
-
-        def stage_fn_moe(params, h, token_w):
-            """Unrolled stage walk over the per-stage pattern, picking
-            each layer's params from its kind's pp-sharded stack."""
             if SP > 1 and h.shape[1] % max(1, cfg.moe_group_size):
                 # Trace-time contract: groups must tile the per-shard
                 # sequence rows so every group lives inside ONE sp
@@ -1056,23 +1074,43 @@ def make_pp_train_step(
                     f"sequence length ({h.shape[1]}); set "
                     "moe_group_size to a divisor of seq/sp"
                 )
+            x_mid = _attn_half(cfg, lp, h)
+            h_ln = _ln(lp["ln_mlp"], x_mid, dt)
+            moe_out, aux, dropped, routed = _moe_ffn_ep_dispatch(
+                cfg, lp["moe"], h_ln, token_w, E
+            )
+            return x_mid + moe_out, aux, dropped, routed
+
+        if cfg.remat:
+            moe_apply = jax.checkpoint(moe_apply)
+
+        def walk_moe(pattern_, layers, layers_moe, h, token_w):
+            """Unrolled dense/MoE layer walk over ``pattern_``,
+            indexing each kind's stacked rows in order — the ONE
+            stage-body definition shared by the per-stage walk
+            (stage_fn_moe) and the interleaved per-chunk walk
+            (chunk_forward)."""
             aux = jnp.zeros((), jnp.float32)
             dropped = jnp.zeros((), jnp.float32)
             routed = jnp.zeros((), jnp.float32)
             jd = jm = 0
-            for is_moe in stage_pattern:
+            for is_moe in pattern_:
                 if is_moe:
-                    lp = jax.tree.map(lambda a: a[jm], params["layers_moe"])
+                    lp = jax.tree.map(lambda a: a[jm], layers_moe)
                     h, a, dr, rt = moe_apply(lp, h, token_w)
                     aux = aux + a
                     dropped = dropped + dr
                     routed = routed + rt
                     jm += 1
                 else:
-                    lp = jax.tree.map(lambda a: a[jd], params["layers"])
+                    lp = jax.tree.map(lambda a: a[jd], layers)
                     h = layer_fwd(lp, h)
                     jd += 1
             return h, aux, dropped, routed
+
+        def stage_fn_moe(params, h, token_w):
+            return walk_moe(stage_pattern, params.get("layers"),
+                            params.get("layers_moe"), h, token_w)
 
     def embed(params, ids):
         s = ids.shape[1]
@@ -1567,6 +1605,53 @@ def make_pp_train_step(
         fv_tab, fm_tab = jnp.asarray(_fv), jnp.asarray(_fm)
         bv_tab, bm_tab = jnp.asarray(_bv), jnp.asarray(_bm)
         lps_i = cfg.n_layers // (S * V)
+        if has_moe:
+            chunk_pattern = pattern[:lps_i]
+            nd_c = chunk_pattern.count(False)
+            nm_c = chunk_pattern.count(True)
+
+        def chunk_params(p, v):
+            """Device-local chunk v's layer rows. The dynamic slice
+            transposes to a dynamic-update into zeros, so each
+            backward lands its gradient on the right chunk rows. With
+            MoE, each kind's stack slices by its own per-chunk count
+            (the per-chunk-uniform pattern makes chunk rows
+            contiguous in both stacks)."""
+            if not has_moe:
+                return jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, v * lps_i, lps_i, 0
+                    ),
+                    p["layers"],
+                )
+            cp = {}
+            if nd_c:
+                cp["layers"] = jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, v * nd_c, nd_c, 0
+                    ),
+                    p["layers"],
+                )
+            if nm_c:
+                cp["layers_moe"] = jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, v * nm_c, nm_c, 0
+                    ),
+                    p["layers_moe"],
+                )
+            return cp
+
+        def chunk_forward(p, v, h, tw):
+            """One chunk's stage walk — the interleaved twin of
+            stage_fn/stage_fn_moe, shared by the train ticks and the
+            forward-only eval. Returns (h, aux, dropped, routed);
+            dense chunks return zero observables."""
+            cp = chunk_params(p, v)
+            if not has_moe:
+                z = jnp.zeros((), jnp.float32)
+                return stage_fn(cp, h), z, z, z
+            return walk_moe(chunk_pattern, cp.get("layers"),
+                            cp.get("layers_moe"), h, tw)
 
     def interleaved_grads(params, x, y, w):
         """Interleaved (virtual-stage) 1F1B: each device owns V chunks
@@ -1594,33 +1679,41 @@ def make_pp_train_step(
         M = n_micro
         fwd_ring = [(i, (i + 1) % S) for i in range(S)]
         bwd_ring = [(i, (i - 1) % S) for i in range(S)]
+        dp_n = jax.lax.axis_size(AXIS_DP)
+        if has_moe:
+            # den BEFORE the scan, like plain 1F1B: the aux seeds
+            # consume it, which both weights the aux gradient
+            # correctly (net 1/(n_micro*dp*SP) after the final
+            # /den_safe) and — as a side effect — serializes the dp
+            # psum against the scan's collectives (see the dense-path
+            # barrier note below).
+            den_pre = jax.lax.psum(jnp.sum(w), AXIS_DP)
+            den_pre_safe = jnp.maximum(den_pre, 1.0)
+            aux_seed = den_pre_safe / (n_micro * dp_n * SP)
+        else:
+            aux_seed = jnp.zeros(())
 
-        def chunk_params(p, v):
-            return jax.tree.map(
-                lambda a: jax.lax.dynamic_slice_in_dim(
-                    a, v * lps_i, lps_i, 0
-                ),
-                p["layers"],
-            )
+        def tw_of(mi):
+            return (jnp.broadcast_to(micro_w[mi][:, None], (mb, s_len))
+                    if has_moe else None)
 
         def chunk_outs(p, h_in, v, mi):
             """One chunk's forward + (final-virtual-stage-only) head
-            num — the differentiable unit of the interleaved tick.
-            The dynamic chunk slice transposes to a dynamic-update
-            into zeros, so each backward lands its gradient on the
-            right chunk rows."""
-            h_out = stage_fn(chunk_params(p, v), h_in)
+            num + MoE observables — the differentiable unit of the
+            interleaved tick (the per-tick vjp runs over the first
+            THREE outputs; drop counts are metrics only)."""
+            h_out, aux, dr_, rt_ = chunk_forward(p, v, h_in, tw_of(mi))
             num = jax.lax.cond(
                 (v == V - 1) & (stage == S - 1),
                 lambda: head_loss(p, h_out, micro_y[mi], micro_w[mi])[0],
                 lambda: jnp.zeros(()),
             )
-            return h_out, num
+            return h_out, num, aux, dr_, rt_
 
         zero_grads = jax.tree.map(jnp.zeros_like, params)
 
         def tick(carry, t):
-            ring, fwd_ch, bwd_ch, grads, num = carry
+            ring, fwd_ch, bwd_ch, grads, num, aux, dr, rt = carry
 
             vf = fv_tab[t, stage]
             mf = fm_tab[t, stage]
@@ -1634,15 +1727,22 @@ def make_pp_train_step(
                     lambda: embed(params, micro_x[mf_c]),
                     lambda: fwd_ch,
                 )
-                h_out, n_ = chunk_outs(params, h_in, vf_c, mf_c)
-                return h_in, h_out, n_
+                h_out, n_, a_, dr_, rt_ = chunk_outs(params, h_in,
+                                                     vf_c, mf_c)
+                return h_in, h_out, n_, a_, dr_, rt_
 
             def skip_fwd():
                 z = jnp.zeros((mb, s_len, cfg.d_model), dt)
-                return z, z, jnp.zeros(())
+                zs = jnp.zeros(())
+                return z, z, zs, zs, zs, zs
 
-            h_in, h_out, n_ = jax.lax.cond(fwd_valid, do_fwd, skip_fwd)
+            h_in, h_out, n_, a_, dr_, rt_ = jax.lax.cond(
+                fwd_valid, do_fwd, skip_fwd
+            )
             num = num + n_
+            aux = aux + a_
+            dr = dr + dr_
+            rt = rt + rt_
             ring = jnp.where(
                 fwd_valid,
                 jax.lax.dynamic_update_slice(
@@ -1664,15 +1764,17 @@ def make_pp_train_step(
                 )[0, 0]
                 is_last = (vb_c == V - 1) & (stage == S - 1)
                 _, pull = jax.vjp(
-                    lambda p, h: chunk_outs(p, h, vb_c, mb_c),
+                    lambda p, h: chunk_outs(p, h, vb_c, mb_c)[:3],
                     params, h_saved,
                 )
                 # Last virtual stage: h_out ct comes only through its
                 # own head term; elsewhere seed with the backward-ring
                 # ct (the num seed is harmless off the last stage —
-                # that branch is the zero function there).
+                # that branch is the zero function there). The aux
+                # seed covers the MoE load-balance path (zero for
+                # dense chunks).
                 seed_h = jnp.where(is_last, 0.0, 1.0).astype(dt) * bwd_ch
-                ct_params, ct_h = pull((seed_h, jnp.ones(())))
+                ct_params, ct_h = pull((seed_h, jnp.ones(()), aux_seed))
 
                 def embed_grads():
                     _, epull = jax.vjp(
@@ -1696,7 +1798,7 @@ def make_pp_train_step(
 
             fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
             bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
-            return (ring, fwd_next, bwd_next, grads, num), None
+            return (ring, fwd_next, bwd_next, grads, num, aux, dr, rt), None
 
         def tick_masked(carry, t):
             """The sp>1 interleaved tick: same discipline as the plain
@@ -1709,7 +1811,7 @@ def make_pp_train_step(
             its predicate (vf==V-1 & stage==S-1) is uniform across sp
             members, and invalid ticks clip vf to 0 != V-1 (V>=2), so
             the head never fires on garbage."""
-            ring, fwd_ch, bwd_ch, grads, num = carry
+            ring, fwd_ch, bwd_ch, grads, num, aux, dr, rt = carry
 
             vf = fv_tab[t, stage]
             mf = fm_tab[t, stage]
@@ -1726,8 +1828,14 @@ def make_pp_train_step(
                 lambda: embed(params, micro_x[mf_c]),
                 lambda: fwd_ch,
             )
-            h_out, n_ = chunk_outs(params, h_in, vf_c, mf_c)
+            h_out, n_, a_, dr_, rt_ = chunk_outs(params, h_in, vf_c, mf_c)
             num = num + fv * n_
+            # Bubble ticks route real token weights over garbage
+            # activations (the body must run for its collectives):
+            # validity-mask the MoE observables here.
+            aux = aux + fv * a_
+            dr = dr + fv * dr_
+            rt = rt + fv * rt_
             ring = jnp.where(
                 fwd_valid,
                 jax.lax.dynamic_update_slice(
@@ -1747,7 +1855,7 @@ def make_pp_train_step(
             )[0, 0]
             is_last = (vb_c == V - 1) & (stage == S - 1)
             _, pull = jax.vjp(
-                lambda p, h: chunk_outs(p, h, vb_c, mb_c),
+                lambda p, h: chunk_outs(p, h, vb_c, mb_c)[:3],
                 params, h_saved,
             )
             bv = bwd_valid.astype(jnp.float32)
@@ -1755,7 +1863,7 @@ def make_pp_train_step(
                 jnp.where(bwd_valid & ~is_last, 1.0, 0.0).astype(dt)
                 * bwd_ch
             )
-            ct_params, ct_h = pull((seed_h, bv))
+            ct_params, ct_h = pull((seed_h, bv, bv * aux_seed))
 
             def embed_grads():
                 _, epull = jax.vjp(
@@ -1772,37 +1880,58 @@ def make_pp_train_step(
 
             fwd_next = jax.lax.ppermute(h_out, AXIS_PP, fwd_ring)
             bwd_next = jax.lax.ppermute(ct_h, AXIS_PP, bwd_ring)
-            return (ring, fwd_next, bwd_next, grads, num), None
+            return (ring, fwd_next, bwd_next, grads, num, aux, dr, rt), None
 
+        zs = jnp.zeros(())
         init = (
             jnp.zeros((V, RV, mb, s_len, cfg.d_model), dt),
             jnp.zeros((mb, s_len, cfg.d_model), dt),
             jnp.zeros((mb, s_len, cfg.d_model), dt),
             zero_grads,
-            jnp.zeros(()),
+            zs, zs, zs, zs,
         )
-        (_, _, _, grads, num), _ = jax.lax.scan(
+        (_, _, _, grads, num, aux, dr, rt), _ = jax.lax.scan(
             tick_masked if SP > 1 else tick, init, jnp.arange(T_ticks)
         )
         num_g = jax.lax.psum(num, (AXIS_PP, AXIS_DP))
-        # den is schedule-independent, but its dp psum must NOT float
-        # freely against the scan's collectives: the CPU backend's
-        # thunk executor runs independent collectives in arbitrary
-        # per-device order, and a cross-device inversion (one device
-        # parked in this all-reduce while its dp partner waits inside
-        # a scan ppermute rendezvous) deadlocks on a starved thread
-        # pool — observed on the 8-virtual-device test rig, second
-        # step. Plain 1F1B is naturally immune (its aux_seed makes
-        # the scan consume den); here an optimization_barrier ties
-        # den's input to num_g, pinning the psum strictly after the
-        # scan on every device at zero math cost (a 0*num_g term
-        # could be algebraically simplified away).
-        w_dep = jax.lax.optimization_barrier((jnp.sum(w), num_g))[0]
-        den_g = jax.lax.psum(w_dep, AXIS_DP)
-        den_safe = jnp.maximum(den_g, 1.0)
+        if has_moe:
+            # den was computed BEFORE the scan (the aux seeds consume
+            # it, which also serializes its psum against the scan).
+            den_g, den_safe = den_pre, den_pre_safe
+        else:
+            # den is schedule-independent, but its dp psum must NOT
+            # float freely against the scan's collectives: the CPU
+            # backend's thunk executor runs independent collectives in
+            # arbitrary per-device order, and a cross-device inversion
+            # (one device parked in this all-reduce while its dp
+            # partner waits inside a scan ppermute rendezvous)
+            # deadlocks on a starved thread pool — observed on the
+            # 8-virtual-device test rig, second step. Plain 1F1B is
+            # naturally immune (its aux_seed makes the scan consume
+            # den); here an optimization_barrier ties den's input to
+            # num_g, pinning the psum strictly after the scan on every
+            # device at zero math cost (a 0*num_g term could be
+            # algebraically simplified away).
+            w_dep = jax.lax.optimization_barrier((jnp.sum(w), num_g))[0]
+            den_g = jax.lax.psum(w_dep, AXIS_DP)
+            den_safe = jnp.maximum(den_g, 1.0)
         loss = num_g / den_safe
+        if has_moe:
+            # Same accounting as the other schedules: stages hold
+            # disjoint MoE layers (psum over pp — each layer runs in
+            # exactly one device's chunk), mean over microbatches and
+            # dp shards; sp members hold disjoint sequence-shard
+            # groups (sum over sp / SP).
+            sp_axes = (AXIS_SP,) if SP > 1 else ()
+            aux_g = jax.lax.psum(aux, (AXIS_PP, AXIS_DP) + sp_axes)
+            loss = loss + aux_g / (n_micro * dp_n * SP)
+            dr_g = jax.lax.psum(dr, (AXIS_PP, AXIS_DP) + sp_axes)
+            rt_g = jax.lax.psum(rt, (AXIS_PP, AXIS_DP) + sp_axes)
+            drop_fraction = dr_g / jnp.maximum(rt_g, 1.0)
+        else:
+            drop_fraction = jnp.zeros(())
         grads = jax.tree.map(lambda g: g / den_safe, grads)
-        return loss, den_g, grads, jnp.zeros(())
+        return loss, den_g, grads, drop_fraction
 
     def interleaved_eval_loss(params, x, y, w):
         """Forward-only interleaved schedule: the validation loss on
@@ -1824,13 +1953,9 @@ def make_pp_train_step(
         M = n_micro
         fwd_ring = [(i, (i + 1) % S) for i in range(S)]
 
-        def chunk_params(p, v):
-            return jax.tree.map(
-                lambda a: jax.lax.dynamic_slice_in_dim(
-                    a, v * lps_i, lps_i, 0
-                ),
-                p["layers"],
-            )
+        def tw_of(mi):
+            return (jnp.broadcast_to(micro_w[mi][:, None], (mb, s_len))
+                    if has_moe else None)
 
         def tick(carry, t):
             fwd_ch, num, den = carry
@@ -1846,7 +1971,8 @@ def make_pp_train_step(
                     lambda: embed(params, micro_x[mf_c]),
                     lambda: fwd_ch,
                 )
-                h_out = stage_fn(chunk_params(params, vf_c), h_in)
+                h_out, _, _, _ = chunk_forward(params, vf_c, h_in,
+                                               tw_of(mf_c))
                 n_, d_ = jax.lax.cond(
                     (vf_c == V - 1) & (stage == S - 1),
                     lambda: head_loss(params, h_out, micro_y[mf_c],
@@ -2462,16 +2588,15 @@ def train_distributed_pipeline(
     rng = jax.random.key(seed)
     flax_params = dict(spec.init_params(rng, sample_x=sample_x))["params"]
     pparams = pipeline_params_from_flax(flax_params, cfg)
-    perm = None
-    if virtual_stages and virtual_stages > 1:
-        # Interleaved layout: re-order the stacked layers so device
-        # d's contiguous pp shard holds its V chunks (undone below so
-        # the returned params are in ordinary flax order).
-        perm = interleave_stack_permutation(
-            cfg.n_layers, mesh.shape[AXIS_PP], virtual_stages
+    interleaved = bool(virtual_stages and virtual_stages > 1)
+    if interleaved:
+        # Interleaved layout: re-order the stacked layers (each kind's
+        # stack with its own permutation) so device d's contiguous pp
+        # shard holds its V chunks (undone below so the returned
+        # params are in ordinary flax order).
+        pparams = apply_interleave_permutation(
+            pparams, cfg, mesh.shape[AXIS_PP], virtual_stages
         )
-        pparams["layers"] = jax.tree.map(lambda a: a[perm],
-                                         pparams["layers"])
     state = place_pipeline_state(pparams, tx, mesh)
 
     from sparktorch_tpu.train.sync import (
@@ -2649,10 +2774,11 @@ def train_distributed_pipeline(
         trained = jax.device_get(gather(state.params))
     else:
         trained = jax.device_get(state.params)
-    if perm is not None:
-        inv = np.argsort(perm)
-        trained["layers"] = jax.tree.map(lambda a: a[inv],
-                                         trained["layers"])
+    if interleaved:
+        trained = apply_interleave_permutation(
+            trained, cfg, mesh.shape[AXIS_PP], virtual_stages,
+            inverse=True,
+        )
     out_params = flax_params_from_pipeline(trained, cfg)
     return TrainResult(params=out_params, model_state={},
                        metrics=recorder.records, spec=spec,

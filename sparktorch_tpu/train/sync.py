@@ -185,8 +185,9 @@ def train_distributed(
     ``pipeline_schedule`` ('gpipe' | '1f1b') apply only when the mesh
     has pp>1, as does ``virtual_stages`` (>1 = interleaved 1F1B:
     requires pipeline_schedule='1f1b', n_micro divisible by pp, and a
-    dense stack — tp and sp compose, MoE does not; shrinks the
-    pipeline bubble ~V-fold at O(V*pp) activation memory).
+    dense/MoE pattern uniform across all pp*V chunks — tp, sp, MoE
+    and ep all compose; shrinks the pipeline bubble ~V-fold at
+    O(V*pp) activation memory).
     """
     del device
     spec = deserialize_model(torch_obj)

@@ -1248,6 +1248,85 @@ def test_interleaved_1f1b_sp_exactness():
         )
 
 
+def test_interleaved_1f1b_moe_exactness():
+    """Interleaved 1F1B now composes with MoE (the last composition
+    gap): each virtual stage holds the same dense/MoE chunk pattern,
+    the per-kind stacks slice per chunk and permute independently
+    (apply_interleave_permutation), and the aux seeds ride the
+    per-tick vjp exactly as in plain 1F1B. V=2 must reproduce plain
+    1f1b AND gpipe on the same mesh (losses, drop fractions, eval,
+    SGD lr=1 params in flax order) — and the FULL composition
+    V=2 x sp=2 x ep=2 with all-to-all dispatch must match too."""
+    import optax
+
+    from sparktorch_tpu.train.pipeline import apply_interleave_permutation
+
+    def cfg_moe(**over):
+        return _cfg(n_layers=8, n_experts=4, moe_every=2, moe_top_k=2,
+                    moe_group_size=8, **over)
+
+    def run(V=1, sp=1, ep=1, attn="dense", sched="1f1b",
+            dispatch="auto", n_steps=3, opt="adam"):
+        cfg = cfg_moe(attn_impl=attn, moe_ep_dispatch=dispatch)
+        mesh = build_mesh(MeshConfig(dp=1, pp=2, sp=sp, ep=ep),
+                          jax.devices()[:2 * sp * ep])
+        params = init_pipeline_lm(cfg, jax.random.key(0))
+        if V > 1:
+            params = apply_interleave_permutation(params, cfg, 2, V)
+        tx = optax.adam(1e-2) if opt == "adam" else optax.sgd(1.0)
+        state = place_pipeline_state(params, tx, mesh)
+        step = make_pp_train_step(cfg, tx, mesh, n_micro=4,
+                                  schedule=sched, virtual_stages=V)
+        batch = _batch(cfg, b=8)
+        losses, drops = [], []
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+            drops.append(step.last_drop_fraction)
+        ev = float(step.eval_loss(state, batch))
+        return losses, drops, ev, jax.device_get(state.params)
+
+    l_plain, d_plain, e_plain, _ = run(V=1)
+    l_gp, _, _, _ = run(V=1, sched="gpipe")
+    l_int, d_int, e_int, _ = run(V=2)
+    np.testing.assert_allclose(l_int, l_plain, rtol=1e-5)
+    np.testing.assert_allclose(l_int, l_gp, rtol=1e-5)
+    np.testing.assert_allclose(d_int, d_plain, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(e_int, e_plain, rtol=1e-5)
+
+    _, _, _, p1 = run(V=1, n_steps=1, opt="sgd")
+    _, _, _, p2raw = run(V=2, n_steps=1, opt="sgd")
+    p2 = apply_interleave_permutation(p2raw, cfg_moe(), 2, 2,
+                                      inverse=True)
+    flat1 = jax.tree_util.tree_flatten_with_path(p1)[0]
+    flat2 = jax.tree.leaves(p2)
+    for (path, a), b in zip(flat1, flat2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6,
+            err_msg=str(path),
+        )
+
+    # Every axis at once: interleaved chunks, ring attention over sp,
+    # all-to-all expert dispatch over ep.
+    l_full, _, e_full, _ = run(V=2, sp=2, ep=2, attn="ring",
+                               dispatch="a2a")
+    np.testing.assert_allclose(l_full, l_plain, rtol=1e-5)
+    np.testing.assert_allclose(e_full, e_plain, rtol=1e-5)
+
+
+def test_interleaved_moe_rejects_nonuniform_chunks():
+    import optax
+
+    # 8 layers, moe every 4th: stage-uniform at pp=2 (each stage has
+    # one MoE layer) but NOT chunk-uniform at V=2 (lps=2: chunks
+    # alternate dense-dense / dense-moe).
+    cfg = _cfg(n_layers=8, n_experts=4, moe_every=4)
+    mesh = build_mesh(MeshConfig(dp=4, pp=2), jax.devices()[:8])
+    with pytest.raises(ValueError, match="chunks"):
+        make_pp_train_step(cfg, optax.adam(1e-2), mesh, n_micro=4,
+                           schedule="1f1b", virtual_stages=2)
+
+
 def test_interleaved_schedule_properties():
     """The static interleaved schedule: V=1 degenerates to the plain
     combined-tick count M + 2S - 2; every (chunk, microbatch) pair
