@@ -1306,8 +1306,16 @@ def test_interleaved_1f1b_moe_exactness():
             err_msg=str(path),
         )
 
+    # ep without sp: the NON-masked interleaved tick runs the expert
+    # all-to-all inside the validity cond (predicate uniform across
+    # the ep peers of a stage) — a distinct compiled path from the
+    # sp>1 masked tick below.
+    l_ep, _, e_ep, _ = run(V=2, ep=2, dispatch="a2a")
+    np.testing.assert_allclose(l_ep, l_plain, rtol=1e-5)
+    np.testing.assert_allclose(e_ep, e_plain, rtol=1e-5)
+
     # Every axis at once: interleaved chunks, ring attention over sp,
-    # all-to-all expert dispatch over ep.
+    # all-to-all expert dispatch over ep (the masked tick).
     l_full, _, e_full, _ = run(V=2, sp=2, ep=2, attn="ring",
                                dispatch="a2a")
     np.testing.assert_allclose(l_full, l_plain, rtol=1e-5)
