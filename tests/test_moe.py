@@ -237,6 +237,16 @@ def test_moe_sp_ep_composition_parity():
     np.testing.assert_allclose(l_all, l_ref, rtol=3e-3)
 
 
+def test_moe_fsdp_ep_composition_parity():
+    # fsdp shards the non-expert params (experts already shard over
+    # ep) with XLA inserting the all-gathers; composed with ep it must
+    # reproduce the dp-only numbers — the last untested pairing in the
+    # GSPMD trainer's MoE composition matrix.
+    l_ref = _run_steps(MeshConfig(), n_steps=5)
+    l_f = _run_steps(MeshConfig(dp=2, fsdp=2, ep=2), n_steps=5)
+    np.testing.assert_allclose(l_f, l_ref, rtol=3e-3)
+
+
 def test_moe_tp_ep_composition_parity():
     # tp shards the experts' inner d_ff dim on top of ep sharding the
     # expert dim; composed layouts must reproduce the dp-only numbers

@@ -170,22 +170,17 @@ def test_barrier_mode_two_process_world(data):
     assert acc > 0.9, acc
 
 
-@pytest.mark.slow
-def test_barrier_two_process_pp_pre_sharded(spark):
-    """pre_sharded under pp>1 (the last Param-contract gap): a
-    gang-launched 2-process world assembles the global batch with
-    train_distributed_multihost and trains a pipeline-parallel LM —
-    the pp route consuming the globally-sharded DataBatch directly
-    (pre_sharded=True), dp=8 x pp=2 over the 16-device world."""
+def _gang_train_lm(spark, cfg, **train_kwargs):
+    """Shared scaffold for the 2-process barrier LM trainings: build a
+    16-row token frame, gang-launch a 2-task barrier stage, bring up
+    the 2-process jax.distributed world, train over a dp=8 x pp=2 mesh
+    with ``train_distributed_multihost`` (pre-sharded global batch),
+    and return rank 0's per-iteration metrics dicts."""
     import numpy as _np
 
     from sparktorch_tpu.models import CausalLM
-    from sparktorch_tpu.models.transformer import TransformerConfig
     from sparktorch_tpu.native.gang import GangCoordinator
 
-    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
-                            n_layers=4, d_ff=64, max_len=16,
-                            dtype="float32")
     payload = serialize_model(CausalLM(cfg), "cross_entropy", "adam",
                               {"lr": 1e-2}, input_shape=(16,))
     rng = _np.random.default_rng(0)
@@ -214,17 +209,15 @@ def test_barrier_two_process_pp_pre_sharded(spark):
             gang_port=gang_port, start_coordinator=False,
         )
         try:
-            import jax
-
             from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
 
             mesh = build_mesh(MeshConfig(pp=2))  # dp=8 x pp=2 over 16
             result = train_distributed_multihost(
                 payload, toks[:, :-1], local_y=toks[:, 1:], mesh=mesh,
-                iters=4, n_micro=2,
+                **train_kwargs,
             )
             if rank == 0:
-                yield [m["loss"] for m in result.metrics]
+                yield result.metrics
         finally:
             if worker is not None:
                 worker.close()
@@ -234,10 +227,54 @@ def test_barrier_two_process_pp_pre_sharded(spark):
         out = rdd.barrier().mapPartitions(run_host).collect()
     finally:
         coord.stop()
-    (losses,) = out
+    (metrics,) = out
+    return metrics
+
+
+@pytest.mark.slow
+def test_barrier_two_process_pp_pre_sharded(spark):
+    """pre_sharded under pp>1 (the last Param-contract gap): a
+    gang-launched 2-process world assembles the global batch with
+    train_distributed_multihost and trains a pipeline-parallel LM —
+    the pp route consuming the globally-sharded DataBatch directly
+    (pre_sharded=True), dp=8 x pp=2 over the 16-device world."""
+    import numpy as _np
+
+    from sparktorch_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=4, d_ff=64, max_len=16,
+                            dtype="float32")
+    metrics = _gang_train_lm(spark, cfg, iters=4, n_micro=2)
+    losses = [m["loss"] for m in metrics]
     assert len(losses) == 4
     assert all(_np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_barrier_two_process_interleaved_moe(spark):
+    """The closed composition matrix survives the MULTI-PROCESS
+    world: the same gang-launched 2-process barrier stage trains an
+    MoE LM under the interleaved 1F1B schedule (virtual_stages=2) —
+    the per-kind stack permutations, aux seeds, and drop metrics all
+    riding the multihost route on the pre-sharded global batch."""
+    import numpy as _np
+
+    from sparktorch_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=8, d_ff=64, max_len=16,
+                            dtype="float32", n_experts=4, moe_every=2,
+                            moe_top_k=2, moe_group_size=16)
+    metrics = _gang_train_lm(spark, cfg, iters=4, n_micro=2,
+                             pipeline_schedule="1f1b", virtual_stages=2)
+    losses = [m["loss"] for m in metrics]
+    drops = [m.get("moe_drop_fraction") for m in metrics]
+    assert len(losses) == 4
+    assert all(_np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+    assert drops[0] is not None and _np.isfinite(drops[0])
 
 
 @pytest.mark.slow
