@@ -5,15 +5,28 @@
 PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
-	cluster-up clean
+	cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
 
-test:
+# Library code must not print: structured telemetry goes through
+# sparktorch_tpu.obs (spans/counters/JSONL//metrics), human lines
+# through obs.log.get_logger. The reference's print-based story
+# (distributed.py:201-204, hogwild.py:133-134) must not creep back in.
+# bench.py is the CLI — its stdout JSON lines are its contract.
+lint-obs:
+	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
+		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: raw print() in library code (use obs.get_logger):"; \
+		echo "$$hits"; exit 1; \
+	fi; echo "lint-obs OK"
+
+test: lint-obs
 	$(PYTHON) -m pytest tests/ -q
 
-test-fast:
+test-fast: lint-obs
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
 # Real pyspark + JVM persistence harness (skips without pyspark/java;

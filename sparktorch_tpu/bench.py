@@ -122,58 +122,74 @@ def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
     regression is distinguishable from residual noise."""
     import jax
 
+    from sparktorch_tpu.obs import get_telemetry
     from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh, replicated
     from sparktorch_tpu.train.step import create_train_state, make_train_epoch
     from sparktorch_tpu.train.sync import prepare_sharded_batch
     from sparktorch_tpu.utils.data import handle_features
 
+    # Per-phase attribution for the BENCH record: every phase below is
+    # a span on the process bus, and the record carries the phase-
+    # seconds breakdown — so a regression names its phase (data, init,
+    # compile+warmup, measure) instead of being one opaque rate drop.
+    tele = get_telemetry()
     devices = jax.devices()
     mesh = build_mesh(MeshConfig(), devices)
-    batch, _ = handle_features(x, y)
-    batch = prepare_sharded_batch(batch, mesh)
+    with tele.span("bench/data") as _sp_data:
+        batch, _ = handle_features(x, y)
+        batch = prepare_sharded_batch(batch, mesh)
+        _sp_data.sync(batch.x)
     tx = spec.make_optimizer()
-    with mesh:
+    with tele.span("bench/init") as _sp_init, mesh:
         state = jax.jit(
             lambda: create_train_state(spec, jax.random.key(0),
                                        sample_x=batch.x[:1], tx=tx),
             out_shardings=replicated(mesh),
         )()
-    epoch = make_train_epoch(spec.make_module().apply, spec.loss_fn(), tx,
-                             mesh, steps_per_call=iters)
-    cost = None
-    if with_cost_analysis:
-        epoch1 = make_train_epoch(spec.make_module().apply, spec.loss_fn(),
-                                  tx, mesh, steps_per_call=1)
-        cost, compiled = _xla_cost_per_step(epoch, epoch1, state, batch)
-        if compiled is not None:
-            epoch = compiled  # one compile serves analysis AND timing
-    for _ in range(warmup):
-        state, metrics = epoch(state, batch)
-    _materialize(metrics.loss)
+        _sp_init.sync(state.step)
+    with tele.span("bench/compile_warmup") as _sp_warm:
+        epoch = make_train_epoch(spec.make_module().apply, spec.loss_fn(), tx,
+                                 mesh, steps_per_call=iters)
+        cost = None
+        if with_cost_analysis:
+            epoch1 = make_train_epoch(spec.make_module().apply,
+                                      spec.loss_fn(), tx, mesh,
+                                      steps_per_call=1)
+            cost, compiled = _xla_cost_per_step(epoch, epoch1, state, batch)
+            if compiled is not None:
+                epoch = compiled  # one compile serves analysis AND timing
+        for _ in range(warmup):
+            state, metrics = epoch(state, batch)
+        _materialize(metrics.loss)
+        _sp_warm.synced = True  # _materialize above fenced the device
 
     slopes = []  # per-step seconds, one sample per repeat
     n_long = max(chunks, 2)
-    for _ in range(max(2, repeats)):
-        t0 = time.perf_counter()
-        state, metrics = epoch(state, batch)
-        _materialize(metrics.loss)
-        t_short = time.perf_counter() - t0
-        while True:
+    with tele.span("bench/measure") as _sp_measure:
+        for _ in range(max(2, repeats)):
             t0 = time.perf_counter()
-            for _ in range(n_long):
-                state, metrics = epoch(state, batch)
+            state, metrics = epoch(state, batch)
             _materialize(metrics.loss)
-            t_long = time.perf_counter() - t0
-            # The difference must dwarf the sync-cost jitter (+-40 ms
-            # observed): grow the long span until the extra compute is
-            # >= 1.6 s, so jitter stays a <=2.5% effect. The grown
-            # span carries over to the remaining repeats.
-            if t_long - t_short >= 1.6 or n_long >= 512:
-                break
-            n_long *= 2
-        # n_long calls vs 1 call: the extra (n_long-1)*iters steps ran
-        # with zero extra syncs, so the difference is pure step time.
-        slopes.append((t_long - t_short) / max((n_long - 1) * iters, 1))
+            t_short = time.perf_counter() - t0
+            while True:
+                t0 = time.perf_counter()
+                for _ in range(n_long):
+                    state, metrics = epoch(state, batch)
+                _materialize(metrics.loss)
+                t_long = time.perf_counter() - t0
+                # The difference must dwarf the sync-cost jitter
+                # (+-40 ms observed): grow the long span until the
+                # extra compute is >= 1.6 s, so jitter stays a <=2.5%
+                # effect. The grown span carries over to the
+                # remaining repeats.
+                if t_long - t_short >= 1.6 or n_long >= 512:
+                    break
+                n_long *= 2
+            # n_long calls vs 1 call: the extra (n_long-1)*iters steps
+            # ran with zero extra syncs, so the difference is pure
+            # step time.
+            slopes.append((t_long - t_short) / max((n_long - 1) * iters, 1))
+        _sp_measure.synced = True  # every iteration ended in a fence
     # An RTT drop between the paired spans can push a sample to ~0 or
     # negative; the median over repeats is robust to those, but drop
     # them from the reported spread so it reflects usable samples.
@@ -203,6 +219,14 @@ def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
         "rate_spread_pct": round(spread_pct, 1),
         "n_chips": len(devices),
         "final_loss": float(np.asarray(metrics.loss)[-1]),
+        # Where this config's wall time went — the per-phase breakdown
+        # the BENCH logs owe (mirrors the bus's bench/* spans).
+        "phase_s": {
+            "data": round(_sp_data.duration_s, 3),
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+        },
         **_steps_summary(good),
     }
     if cost is not None:
@@ -812,10 +836,22 @@ def main(argv: Optional[List[str]] = None) -> None:
                         choices=["headline", "all", *CONFIGS])
     parser.add_argument("--log", default=None,
                         help="append raw result records to this JSONL file")
+    parser.add_argument("--telemetry-dump", default=None, metavar="PATH",
+                        help="append the run's full telemetry snapshot "
+                             "(counters, gauges, histogram/span roll-ups) "
+                             "as one JSONL line — the CLI twin of the "
+                             "param server's /metrics route")
     args = parser.parse_args(argv)
+
+    def _dump_telemetry() -> None:
+        if args.telemetry_dump:
+            from sparktorch_tpu.obs import get_telemetry
+
+            get_telemetry().dump(args.telemetry_dump)
 
     if args.config == "headline":
         print(json.dumps(_headline()))
+        _dump_telemetry()
         return
 
     names = list(CONFIGS) if args.config == "all" else [args.config]
@@ -837,6 +873,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         with open(args.log, "a") as f:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
+    _dump_telemetry()
 
 
 if __name__ == "__main__":

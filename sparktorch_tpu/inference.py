@@ -38,7 +38,7 @@ class BatchPredictor:
 
     def __init__(self, module, params, model_state=None,
                  mesh: Optional[Mesh] = None, chunk: int = 1024,
-                 preprocess=None, postprocess=None):
+                 preprocess=None, postprocess=None, telemetry=None):
         """``preprocess``/``postprocess`` (optional jax fns) are fused
         INTO the compiled forward. preprocess lets the wire carry the
         raw column dtype (e.g. uint8 pixels straight out of Parquet)
@@ -49,8 +49,15 @@ class BatchPredictor:
         112-120``, computed on device: 1 value/row over the wire
         instead of the logits row). Both matter most when hosts are
         remote from the chips."""
+        from sparktorch_tpu.obs import get_telemetry
+
         self.module = module
         self.mesh = mesh
+        # Serving metrics on the shared bus: rows/batches served,
+        # request latency percentiles, and batch fill (real rows over
+        # padded chunk rows — low fill means the compiled shape is
+        # oversized for the traffic).
+        self.telemetry = telemetry or get_telemetry()
         n_shards = 1
         if mesh is not None:
             from sparktorch_tpu.parallel.mesh import BATCH_AXES
@@ -113,6 +120,8 @@ class BatchPredictor:
                         pad = jnp.zeros((target - real, *part.shape[1:]),
                                         part.dtype)
                         part = jnp.concatenate([part, pad])
+            self.telemetry.observe("inference.batch_fill",
+                                   real / max(1, part.shape[0]))
             yield part, real
 
     def _put(self, part):
@@ -146,6 +155,9 @@ class BatchPredictor:
                 self._fwd(self._params, self._model_state, self._put(probe))
             )
             return out[:0]
+        import time as _time
+
+        t0 = _time.perf_counter()
         parts = self._chunks(x, n)
         host = []
         nxt = next(parts, None)
@@ -161,7 +173,15 @@ class BatchPredictor:
                 host.append(np.asarray(prev[0])[: prev[1]])
             prev = (out, real)
         host.append(np.asarray(prev[0])[: prev[1]])
-        return np.concatenate(host) if len(host) > 1 else host[0]
+        out = np.concatenate(host) if len(host) > 1 else host[0]
+        # The readback loop above drained the device, so this latency
+        # covers transfer+compute honestly (not just dispatch).
+        tele = self.telemetry
+        tele.observe("inference.predict_s", _time.perf_counter() - t0,
+                     labels={"path": "host"})
+        tele.counter("inference.requests", labels={"path": "host"})
+        tele.counter("inference.rows", float(n), labels={"path": "host"})
+        return out
 
     def predict_device(self, x, in_flight: int = 3):
         """Chunked forward with no device->host readbacks: returns ONE
@@ -187,6 +207,9 @@ class BatchPredictor:
             out = self._fwd(self._params, self._model_state,
                             self._put(probe))
             return out[:0]
+        import time as _time
+
+        t0 = _time.perf_counter()
         outs = []
         pending = []
         for part, real in self._chunks(x, n):
@@ -197,6 +220,13 @@ class BatchPredictor:
             if len(pending) >= max(2, in_flight):
                 # Transfer-free backpressure: bound live input buffers.
                 pending.pop(0).block_until_ready()
+        tele = self.telemetry
+        # Dispatch latency only — this path deliberately never fences
+        # (see docstring); the caller's eventual download is the sync.
+        tele.observe("inference.predict_s", _time.perf_counter() - t0,
+                     labels={"path": "device"})
+        tele.counter("inference.requests", labels={"path": "device"})
+        tele.counter("inference.rows", float(n), labels={"path": "device"})
         return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
 
     def predict_stream(self, batches: Iterable[np.ndarray]) -> Iterator[np.ndarray]:
@@ -363,6 +393,7 @@ def stream_parquet_predict(
                 except _queue.Full:
                     continue
 
+    tele = predictor.telemetry
     t = threading.Thread(target=reader, daemon=True)
     t_start = _time.perf_counter()
     t.start()
@@ -373,6 +404,10 @@ def stream_parquet_predict(
         while True:
             try:
                 item = q.get(timeout=1.0)
+                # Depth AFTER the pop: 0 means the reader is the
+                # bottleneck (compute starves); ~prefetch means the
+                # predictor is (queue saturated).
+                tele.observe("inference.queue_depth", q.qsize())
             except _queue.Empty:
                 # Sentinel-free end detection: a dead reader with an
                 # empty queue is end-of-stream (or a reader crash —
@@ -405,6 +440,8 @@ def stream_parquet_predict(
     if reader_err:
         raise reader_err[0]
     wall = _time.perf_counter() - t_start
+    tele.counter("inference.stream_runs")
+    tele.counter("inference.stream_rows", float(n_rows))
     return {
         "n_rows": n_rows,
         "n_batches": n_batches,

@@ -21,11 +21,13 @@ in an XLA collective.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 import time
-from typing import List
+from typing import List, Optional
 
 from sparktorch_tpu.native.build import load_library
+from sparktorch_tpu.obs.heartbeat import HEARTBEAT_DIR_ENV, HeartbeatEmitter
 
 
 class GangFailure(RuntimeError):
@@ -99,9 +101,20 @@ class GangWorker:
     """Per-host client: register, barrier, heartbeat, peer table."""
 
     def __init__(self, host: str, port: int, rank: int, address: str,
-                 timeout_ms: int = 30_000, heartbeat_interval_s: float = 2.0):
+                 timeout_ms: int = 30_000, heartbeat_interval_s: float = 2.0,
+                 heartbeat_dir: Optional[str] = None, telemetry=None):
         self._lib = _lib()
         self.rank = rank
+        # Rank/host-attributed liveness publishing (obs.heartbeat):
+        # the native protocol is a liveness BIT; the emitter adds WHO
+        # and HOW FAR (rank, host, pid, training step, last-seen ts),
+        # readable by anything sharing the directory. Enabled by the
+        # kwarg or the SPARKTORCH_TPU_HEARTBEAT_DIR env var.
+        heartbeat_dir = heartbeat_dir or os.environ.get(HEARTBEAT_DIR_ENV)
+        self.heartbeat = (
+            HeartbeatEmitter(heartbeat_dir, rank, telemetry=telemetry)
+            if heartbeat_dir else None
+        )
         # Kept for heartbeat-socket reconnection (re-REG overwrites
         # members[rank] server-side while the gang is healthy; once the
         # gang has failed the coordinator refuses with DEAD).
@@ -143,6 +156,16 @@ class GangWorker:
     def _heartbeat_loop(self, interval: float):
         io_failures = 0
         while not self._hb_stop.wait(interval):
+            if self.heartbeat is not None:
+                # Attributed liveness rides the same cadence as the
+                # native liveness bit: rank/host/pid/step/ts land in
+                # the shared directory every tick. Never let a full
+                # disk kill the native channel that actually keeps
+                # this member alive.
+                try:
+                    self.heartbeat.beat()
+                except OSError:
+                    pass
             with self._hb_lock:
                 if self._hb_handle is None:
                     return
@@ -229,6 +252,15 @@ class GangWorker:
 
     def close(self):
         self._hb_stop.set()
+        if self.heartbeat is not None:
+            # Join the heartbeat thread BEFORE the final beat: a tick
+            # already past its stop-check would otherwise publish
+            # alive=True after (and over) the alive=False record.
+            self._hb_thread.join(timeout=5.0)
+            # Final alive=False beat: a CLEAN shutdown is readable in
+            # the heartbeat table, distinct from a silent death whose
+            # last record just ages with alive=True.
+            self.heartbeat.close()
         with self._hb_lock:
             if self._hb_handle:
                 self._lib.gang_client_close(self._hb_handle)

@@ -1,0 +1,49 @@
+"""sparktorch_tpu.obs — the unified telemetry subsystem.
+
+One bus (:class:`Telemetry`) shared by every trainer, the parameter
+server, inference, and the bench CLI: nestable timed spans, monotonic
+counters, histogram metrics with p50/p95/p99 roll-ups, gauges. Sinks
+stream JSONL events; :func:`render_prometheus` serves the same state
+from the param server's ``/metrics`` route; gang heartbeats give
+multi-process runs per-rank liveness and step skew.
+"""
+
+from sparktorch_tpu.obs.telemetry import (
+    Span,
+    Telemetry,
+    format_key,
+    get_telemetry,
+    set_telemetry,
+)
+from sparktorch_tpu.obs.sinks import JsonlSink, read_jsonl, write_jsonl
+from sparktorch_tpu.obs.prom import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
+    parse_prometheus,
+    render_prometheus,
+)
+from sparktorch_tpu.obs.heartbeat import (
+    HEARTBEAT_DIR_ENV,
+    HeartbeatEmitter,
+    gang_report,
+    read_heartbeats,
+)
+from sparktorch_tpu.obs.log import get_logger
+
+__all__ = [
+    "Span",
+    "Telemetry",
+    "format_key",
+    "get_telemetry",
+    "set_telemetry",
+    "JsonlSink",
+    "read_jsonl",
+    "write_jsonl",
+    "PROMETHEUS_CONTENT_TYPE",
+    "parse_prometheus",
+    "render_prometheus",
+    "HEARTBEAT_DIR_ENV",
+    "HeartbeatEmitter",
+    "gang_report",
+    "read_heartbeats",
+    "get_logger",
+]

@@ -1,0 +1,33 @@
+"""Library logging: the replacement for the reference's raw prints.
+
+``make lint-obs`` fails the build on any ``print(`` in library code —
+this logger is where human-readable progress lines go instead. One
+stderr handler, configured once, never propagating into a host app's
+root logger; set the ``SPARKTORCH_TPU_LOG_LEVEL`` env var (DEBUG,
+INFO, ...) to change verbosity process-wide.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+_LOCK = threading.Lock()
+_CONFIGURED = False
+
+
+def get_logger(name: str = "sparktorch_tpu") -> logging.Logger:
+    global _CONFIGURED
+    root = logging.getLogger("sparktorch_tpu")
+    with _LOCK:
+        if not _CONFIGURED:
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter("%(message)s"))
+            root.addHandler(handler)
+            root.setLevel(
+                os.environ.get("SPARKTORCH_TPU_LOG_LEVEL", "INFO").upper()
+            )
+            root.propagate = False
+            _CONFIGURED = True
+    return logging.getLogger(name)

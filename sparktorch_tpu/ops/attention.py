@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from sparktorch_tpu.parallel.compat import axis_size as _axis_size
+
 
 def dense_attention(
     q: jax.Array,
@@ -86,7 +88,7 @@ def ring_attention(
     block originally owned by device ``(i - s) mod n`` and forwards
     its current block to ``(i + 1) mod n``.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     seq_local = q.shape[1]
     scale = q.shape[-1] ** -0.5

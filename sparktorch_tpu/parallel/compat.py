@@ -1,0 +1,34 @@
+"""JAX version compatibility shims.
+
+The codebase targets current JAX, but deployment images pin older
+releases (this container ships 0.4.x). Two APIs the hot paths use
+landed after 0.4.37; both have exact equivalents there:
+
+- ``jax.lax.axis_size(name)`` — the static size of a mapped axis.
+  Equivalent: ``jax.lax.psum(1, name)``, which JAX constant-folds to
+  the axis size from the static axis env (no collective is emitted).
+- ``jax.set_mesh(mesh)`` as a context manager — the ambient mesh.
+  Equivalent: ``with mesh:`` (``Mesh.__enter__``), which is what
+  resolves shard_map/with_sharding_constraint axis names here.
+
+Call sites import from this module so the same wheel runs on both
+sides of the API change.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(name) -> jax.Array:
+    """Static size of the mapped axis ``name`` (int under tracing)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
+
+
+def set_mesh(mesh):
+    """Context manager installing ``mesh`` as the ambient mesh."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh

@@ -58,6 +58,21 @@ def check_gang() -> None:
     worker.check()
 
 
+def notify_gang_step(step: int) -> None:
+    """Publish this process's training progress on its gang heartbeat
+    (rank/host-attributed; see obs.heartbeat) so the driver — or any
+    process sharing the heartbeat directory — can read per-rank step
+    skew. No-op without an active gang or a heartbeat directory.
+    Trainers call it next to check_gang(), once per compiled dispatch
+    — file-write cost only when heartbeats are actually enabled."""
+    worker = _ACTIVE_WORKER
+    if worker is None or worker.closed:
+        return
+    hb = getattr(worker, "heartbeat", None)
+    if hb is not None:
+        hb.notify_step(step)
+
+
 def _local_ip() -> str:
     # SPARK_LOCAL_IP is honored for drop-in parity with the
     # reference's address resolution (distributed.py:35-36).

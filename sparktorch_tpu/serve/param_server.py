@@ -31,8 +31,10 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 
@@ -40,6 +42,11 @@ import dill
 import jax
 import numpy as np
 
+from sparktorch_tpu.obs import (
+    PROMETHEUS_CONTENT_TYPE,
+    Telemetry,
+    render_prometheus,
+)
 from sparktorch_tpu.utils.early_stopper import EarlyStopping
 from sparktorch_tpu.utils.locks import VersionedSlot
 from sparktorch_tpu.utils.serde import ModelSpec, deserialize_model
@@ -58,10 +65,16 @@ class ParameterServer:
         acquire_lock: bool = True,
         device: Optional[jax.Device] = None,
         seed: int = 0,
+        telemetry: Optional[Telemetry] = None,
     ):
         # The server deserializes its own model copy, like
         # server.py:44-51 — but params go straight to device HBM.
         self.spec: ModelSpec = deserialize_model(torch_obj)
+        # Server-scoped bus (not the process global): each server's
+        # counters are its own, so a test or driver hosting several
+        # servers never cross-talks. The HTTP wire serves this very
+        # instance from /metrics.
+        self.telemetry = telemetry or Telemetry(run_id="param_server")
         self.device = device or jax.devices()[0]
         self.acquire_lock = acquire_lock  # parity knob; applies are
         # always serialized by the single writer thread.
@@ -119,7 +132,11 @@ class ParameterServer:
         Parity: ``GET /parameters`` (server.py:93-100), minus the
         redundant-transfer pathology.
         """
-        return self.slot.read_if_newer(have_version)
+        snap = self.slot.read_if_newer(have_version)
+        self.telemetry.counter("param_server.pulls")
+        if snap is not None:
+            self.telemetry.counter("param_server.pull_fresh")
+        return snap
 
     def model_state(self):
         return self._model_state
@@ -149,6 +166,8 @@ class ParameterServer:
             raise RuntimeError("parameter server failed") from self._failed
         done = threading.Event() if wait else None
         self._queue.put((grads, done))
+        self.telemetry.counter("param_server.pushes")
+        self.telemetry.gauge("param_server.queue_depth", self._queue.qsize())
         if done is not None and not done.wait(timeout):
             raise TimeoutError("parameter server apply timed out")
 
@@ -159,6 +178,7 @@ class ParameterServer:
             except queue.Empty:
                 continue
             try:
+                t0 = time.perf_counter()
                 version, params = self.slot.read()
                 grads = jax.device_put(grads, self.device)
                 new_params, new_opt = self._apply_fn(
@@ -167,8 +187,13 @@ class ParameterServer:
                 self._opt_state = new_opt
                 self.slot.swap(new_params)
                 self._applied += 1
+                self.telemetry.counter("param_server.applies")
+                self.telemetry.observe("param_server.apply_s",
+                                       time.perf_counter() - t0)
+                self.telemetry.gauge("param_server.version", version + 1)
             except Exception as e:  # tolerate a bounded error count
                 self._errors += 1
+                self.telemetry.counter("param_server.apply_errors")
                 if self._errors > MAX_TOLERATED_ERRORS:
                     self._failed = e
                     self._running = False
@@ -196,6 +221,7 @@ class ParameterServer:
         Parity: ``POST /losses`` (server.py:102-123): collect one loss
         per worker, average a full window, feed the patience tracker.
         """
+        self.telemetry.counter("param_server.losses_posted")
         with self._loss_lock:
             if self._stop_flag:
                 return True
@@ -247,6 +273,12 @@ class ParamServerHttp:
     ``X-Have-Version`` header with 204 when not newer),
     ``POST /update`` (dill grads), ``POST /losses`` (dill float ->
     dill {'stop': bool}).
+
+    Observability routes beyond the reference: ``GET /metrics`` serves
+    the server's telemetry as Prometheus exposition text (scrapeable),
+    and ``GET /telemetry`` the same snapshot as JSON — both rendered
+    from ONE ``Telemetry.snapshot()``, so a scrape can never disagree
+    with the JSONL dump of the same server.
     """
 
     def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
@@ -288,14 +320,20 @@ class ParamServerHttp:
             def log_message(self, *a):  # quiet, like werkzeug->ERROR
                 pass  # (server.py:28-30 parity)
 
-            def _send(self, code: int, body: bytes = b""):
+            def _send(self, code: int, body: bytes = b"",
+                      content_type: Optional[str] = None):
                 self.send_response(code)
+                if content_type:
+                    self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 if body:
                     self.wfile.write(body)
 
             def do_GET(self):
+                route = self.path.split("?", 1)[0]
+                ps.telemetry.counter("param_server.http_requests",
+                                     labels={"route": route})
                 if self.path == "/":
                     self._send(200, b"sparktorch-tpu parameter server")
                 elif self.path.startswith("/parameters"):
@@ -305,10 +343,24 @@ class ParamServerHttp:
                         self._send(204)
                     else:
                         self._send(200, body)
+                elif route == "/metrics":
+                    text = render_prometheus(ps.telemetry.snapshot())
+                    self._send(200, text.encode(),
+                               content_type=PROMETHEUS_CONTENT_TYPE)
+                elif route == "/telemetry":
+                    self._send(200,
+                               json.dumps(ps.telemetry.snapshot()).encode(),
+                               content_type="application/json")
                 else:
                     self._send(404)
 
             def do_POST(self):
+                # Label with the query-stripped route (like do_GET):
+                # raw paths would split one route across series and
+                # let a client grow label cardinality without bound.
+                route = self.path.split("?", 1)[0]
+                ps.telemetry.counter("param_server.http_requests",
+                                     labels={"route": route})
                 length = int(self.headers.get("Content-Length", "0"))
                 raw = self.rfile.read(length)
                 if self.path == "/update":

@@ -34,11 +34,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparktorch_tpu.obs import get_logger, get_telemetry
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
 from sparktorch_tpu.train.step import _sown_total
 from sparktorch_tpu.train.sync import TrainResult, _as_batch
 from sparktorch_tpu.utils.data import DataBatch
 from sparktorch_tpu.utils.serde import deserialize_model
+from sparktorch_tpu.utils.tracing import profile_run, step_annotation
 
 _HTTP_TIMEOUT = 10.0  # hogwild.py:34-38 parity (10s timeout, 1 retry)
 # Pulls carry the full model snapshot; on a tunnel-attached chip the
@@ -323,6 +325,7 @@ def _worker_loop(
     eval_loss=None,
     grad_windows=None,
     phase_out: Optional[List[dict]] = None,
+    telemetry=None,
 ):
     """One worker's training loop.
 
@@ -336,6 +339,9 @@ def _worker_loop(
     iteration serializes the pipeline on a host round-trip that costs
     more than the gradient step itself on remote-attached chips.
     """
+    tele = telemetry or get_telemetry()
+    log = get_logger("sparktorch_tpu.train.hogwild")
+    labels = {"worker": worker_id}
     try:
         if hasattr(transport, "stats"):
             # Fresh per-round stats: the transport object survives
@@ -363,20 +369,24 @@ def _worker_loop(
             key, sub = jax.random.split(key)
             k = min(window_k, iters - it)
             t0 = time.perf_counter()
-            if window_k > 1 and grad_windows is not None:
-                fn = grad_windows[0] if k == window_k else grad_windows[1]
-                grads, losses = fn(params, model_state, shard, sub)
-            else:
-                k = 1
-                grads, losses = grad_step(params, model_state, shard, sub)
+            with step_annotation(it, telemetry=tele):
+                if window_k > 1 and grad_windows is not None:
+                    fn = grad_windows[0] if k == window_k else grad_windows[1]
+                    grads, losses = fn(params, model_state, shard, sub)
+                else:
+                    k = 1
+                    grads, losses = grad_step(params, model_state, shard, sub)
             t_dispatch += time.perf_counter() - t0
             transport.push(grads)
+            tele.counter("hogwild.iters", k, labels=labels)
+            tele.counter("hogwild.pushes", labels=labels)
+            tele.gauge("hogwild.pulled_version", have_version, labels=labels)
             pending.append((it, k, have_version, losses, time.perf_counter()))
             it += k
             if verbose:
                 last = jnp.reshape(jnp.asarray(losses), (-1,))[-1]
-                print(f"[sparktorch_tpu:hogwild] worker {worker_id} "
-                      f"iter {it - 1} loss {float(last):.6f} v{have_version}")
+                log.info(f"[sparktorch_tpu:hogwild] worker {worker_id} "
+                         f"iter {it - 1} loss {float(last):.6f} v{have_version}")
             if early_stop:
                 if eval_loss is not None and val_shard is not None:
                     signal = float(eval_loss(params, model_state, val_shard))
@@ -417,6 +427,15 @@ def _worker_loop(
                 "iters": it,
             })
             phase_out.append(st)
+            # Mirror the per-round phase budget onto the bus so the
+            # same decomposition shows up in /metrics and JSONL dumps
+            # alongside the counters bumped in the loop above.
+            for phase in ("pull_s", "pull_place_s", "dispatch_s",
+                          "push_materialize_s", "push_wire_s", "poll_s",
+                          "drain_s", "loop_s"):
+                if st.get(phase):
+                    tele.observe(f"hogwild.{phase}", float(st[phase]),
+                                 labels=labels)
     except BaseException as e:  # surfaced to the driver
         errors.append(e)
 
@@ -444,6 +463,8 @@ def train_async(
     transport: str = "local",
     push_every: int = 1,
     compress: bool = True,
+    telemetry=None,
+    profile_dir: Optional[str] = None,
 ) -> TrainResult:
     """Asynchronous parameter-server training.
 
@@ -457,22 +478,29 @@ def train_async(
     window, so ``early_stop_patience`` counts k-iteration windows and
     staleness is bounded by one window.
     """
+    tele = telemetry or get_telemetry()
     spec = deserialize_model(torch_obj)
-    train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
+    with tele.span("hogwild/data_prep"):
+        train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
     if spec.input_shape is None:
         spec.input_shape = tuple(np.asarray(train_batch.x).shape[1:])
 
     devices = jax.devices()
     n_workers = partitions if partitions and partitions > 0 else len(devices)
 
+    # The server records into the SAME run-scoped bus as the workers,
+    # so one /metrics scrape (or JSONL dump) tells the whole async
+    # story: pulls/pushes/applies next to worker iters and phase times.
     server = ParameterServer(
         spec,
         window_len=n_workers,  # torch_distributed.py:315-322 parity
         early_stop_patience=early_stop_patience,
         acquire_lock=acquire_lock,
         seed=seed,
+        telemetry=tele,
     )
     http: Optional[ParamServerHttp] = None
+    profiler = None
     try:
         if transport == "http":
             http = ParamServerHttp(server, port=port).start()
@@ -504,6 +532,11 @@ def train_async(
         w = np.asarray(train_batch.w)
         shuffle_rng = np.random.default_rng(seed + 1)
 
+        # XLA trace capture around the worker rounds (the same
+        # profile_dir contract as the sync/pp trainers); exited in the
+        # outer finally so a worker failure still stops the trace.
+        profiler = profile_run(profile_dir, telemetry=tele)
+        profiler.__enter__()
         for round_idx in range(max(1, partition_shuffles)):
             # EVERY round shuffles, round 0 included: the reference's
             # _fit always repartition()s before training
@@ -518,6 +551,7 @@ def train_async(
             xs = np.array_split(x, n_workers)
             ys = np.array_split(y, n_workers)
             ws = np.array_split(w, n_workers)
+            t_round0 = time.perf_counter()
             threads = []
             for i in range(n_workers):
                 shard = DataBatch(
@@ -545,6 +579,7 @@ def train_async(
                         eval_loss,
                         grad_windows,
                         phase_stats,
+                        tele,
                     ),
                     daemon=True,
                 )
@@ -552,6 +587,8 @@ def train_async(
                 t.start()
             for t in threads:
                 t.join()
+            tele.observe("hogwild.round_s", time.perf_counter() - t_round0)
+            tele.counter("hogwild.rounds")
             if errors:
                 raise RuntimeError("hogwild worker failed") from errors[0]
             if server.should_stop:
@@ -586,6 +623,8 @@ def train_async(
             spec=spec, summary=summary,
         )
     finally:
+        if profiler is not None:
+            profiler.__exit__(None, None, None)
         # Stop server even on failure (hogwild.py:184-186 parity).
         if http is not None:
             http.stop()

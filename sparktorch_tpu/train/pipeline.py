@@ -67,6 +67,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparktorch_tpu.models.transformer import EncoderLayer, TransformerConfig
+from sparktorch_tpu.parallel.compat import axis_size as _axis_size
 from sparktorch_tpu.ops.attention import dense_attention
 from sparktorch_tpu.parallel.mesh import (
     AXIS_DP,
@@ -245,7 +246,7 @@ def _ep_gather_fwd(x):
 
 
 def _ep_gather_bwd(_, ct):
-    n_ep = jax.lax.axis_size(AXIS_EP)
+    n_ep = _axis_size(AXIS_EP)
     g_loc = ct.shape[0] // n_ep
     i = jax.lax.axis_index(AXIS_EP)
     return (jax.lax.dynamic_slice_in_dim(ct, i * g_loc, g_loc, 0),)
@@ -1264,7 +1265,7 @@ def make_pp_train_step(
                     _sp_reduce(aux) if SP > 1 else aux,
                     (AXIS_PP, AXIS_DP),
                 )
-                dp_n = jax.lax.axis_size(AXIS_DP)
+                dp_n = _axis_size(AXIS_DP)
                 loss = loss + aux_g / (n_micro * dp_n * SP)
                 dropped_g = jax.lax.psum(
                     dropped, (AXIS_PP, AXIS_DP) + sp_axes
@@ -1332,7 +1333,7 @@ def make_pp_train_step(
         # replicated across pp), so the aux seed below can use it.
         den_g = jax.lax.psum(jnp.sum(w), AXIS_DP)
         den_safe = jnp.maximum(den_g, 1.0)
-        dp_n = jax.lax.axis_size(AXIS_DP)
+        dp_n = _axis_size(AXIS_DP)
         # With sp>1 each member's local aux is a per-shard share of
         # the global aux = (sum over sp of local) / SP, so its
         # gradient weight carries an extra 1/SP.
@@ -1679,7 +1680,7 @@ def make_pp_train_step(
         M = n_micro
         fwd_ring = [(i, (i + 1) % S) for i in range(S)]
         bwd_ring = [(i, (i - 1) % S) for i in range(S)]
-        dp_n = jax.lax.axis_size(AXIS_DP)
+        dp_n = _axis_size(AXIS_DP)
         if has_moe:
             # den BEFORE the scan, like plain 1F1B: the aux seeds
             # consume it, which both weights the aux gradient
@@ -2402,6 +2403,7 @@ def train_distributed_pipeline(
     schedule: str = "gpipe",
     virtual_stages: int = 1,
     pre_sharded: bool = False,
+    telemetry=None,
 ):
     """Pipelined training entry for a ``ModelSpec`` holding a
     ``CausalLM`` — the dispatch target ``train_distributed`` uses when
@@ -2416,8 +2418,13 @@ def train_distributed_pipeline(
     import time
 
     from sparktorch_tpu.models.transformer import CausalLM, SequenceClassifier
+    from sparktorch_tpu.obs import get_logger, get_telemetry
+    from sparktorch_tpu.parallel.launch import check_gang, notify_gang_step
     from sparktorch_tpu.train.sync import TrainResult
     from sparktorch_tpu.utils.metrics import MetricsRecorder
+
+    tele = telemetry or get_telemetry()
+    log = get_logger("sparktorch_tpu.train")
 
     module = spec.make_module()
     if isinstance(module, CausalLM):
@@ -2648,7 +2655,8 @@ def train_distributed_pipeline(
         if early_stop_patience is not None and early_stop_patience > 0
         else None
     )
-    recorder = MetricsRecorder(n_chips=mesh.size)
+    recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele,
+                               prefix="train_pp")
     last_ckpt = int(jax.device_get(state.step)) if ckpt is not None else 0
     start = int(jax.device_get(state.step))
     # Seed folded with the restored step: a resumed run must draw
@@ -2665,7 +2673,7 @@ def train_distributed_pipeline(
     sample_key = jax.random.key(seed + 2 + start)
     completed = False
     stop = False
-    profiler = profile_run(profile_dir)
+    profiler = profile_run(profile_dir, telemetry=tele)
     profiler.__enter__()
     try:
         for shuffle_round in range(max(1, partition_shuffles)):
@@ -2684,9 +2692,17 @@ def train_distributed_pipeline(
                 )
             i = 0
             while i < iters:
+                # Same pre-dispatch liveness check + progress publish
+                # as the DP trainer: a dead peer aborts before the next
+                # compiled schedule (instead of wedging in its
+                # collectives), and this rank's step lands on its gang
+                # heartbeat so the driver can read cross-rank skew.
+                check_gang()
+                notify_gang_step(i)
                 t0 = time.perf_counter()
                 sample_key, sub = jax.random.split(sample_key)
-                with step_annotation(i):
+                with tele.span("train_pp/step_call"), \
+                        step_annotation(i, telemetry=tele):
                     state, out = step(state, batch, key=sub)
                 if steps_per_call == 1:
                     losses = [float(out)]
@@ -2738,10 +2754,12 @@ def train_distributed_pipeline(
                                f"iter {i + j} loss {l:.6f}")
                         if record["val_loss"] is not None:
                             msg += f" val_loss {record['val_loss']:.6f}"
-                        print(msg)
+                        log.info(msg)
                 i += len(losses)
-                last_ckpt = _save_if_due(ckpt, state, last_ckpt,
-                                         checkpoint_every)
+                if ckpt is not None:
+                    with tele.span("train_pp/checkpoint"):
+                        last_ckpt = _save_if_due(ckpt, state, last_ckpt,
+                                                 checkpoint_every)
                 # The global loss is replicated on every host, so the
                 # per-host stopper reaches the identical decision (no
                 # extra collective — same argument as the DP trainer).

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
 from sparktorch_tpu.parallel.mesh import AXIS_SP, BATCH_AXES, replicated
 from sparktorch_tpu.parallel.sharding_rules import shard_params, transformer_rules
 from sparktorch_tpu.train.step import (
@@ -81,7 +82,7 @@ def create_sharded_state(
 
     # Everything under set_mesh: tracing the module may hit the ring-
     # attention shard_map island, which resolves the ambient mesh.
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         abstract = jax.eval_shape(lambda k: module.init(k, sample_x), rng)
         # _split_variables drops the write-only 'losses' collection
         # (sown aux objectives), which must never live in the carried
@@ -141,9 +142,20 @@ def make_sharded_train_step(
     mesh: Mesh,
     state_shardings: TrainState,
     seq_sharded: bool = False,
+    profile_dir: Optional[str] = None,
+    telemetry=None,
 ) -> Callable[[TrainState, DataBatch], Tuple[TrainState, StepMetrics]]:
     """One GSPMD train step: global weighted-mean loss and grads; XLA
-    derives every collective from the shardings."""
+    derives every collective from the shardings.
+
+    Telemetry/tracing (same contract as the sync/pp trainers'
+    ``profile_dir``): every call of the returned ``run`` carries a
+    per-step trace annotation and a ``train_sharded/step`` span on the
+    bus. With ``profile_dir`` set, the FIRST call starts an XLA
+    profiler trace there; the caller owns the loop here (no trainer
+    driver), so it ends the capture with ``run.finish()`` — also safe
+    to call when no profile was requested.
+    """
 
     pass_w = _accepts_example_w(apply_fn)
 
@@ -208,14 +220,33 @@ def make_sharded_train_step(
         donate_argnums=(0,),
     )
 
+    from sparktorch_tpu.obs import get_telemetry
+    from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+    tele = telemetry or get_telemetry()
+    loop_state = {"calls": 0, "profiler": None}
+
     def run(state, batch):
-        with jax.set_mesh(mesh):
+        if profile_dir and loop_state["profiler"] is None:
+            loop_state["profiler"] = profile_run(profile_dir, telemetry=tele)
+            loop_state["profiler"].__enter__()
+        step_no = loop_state["calls"]
+        loop_state["calls"] += 1
+        with _set_mesh(mesh), tele.span("train_sharded/step"), \
+                step_annotation(step_no, telemetry=tele):
             return jitted(state, batch)
+
+    def finish():
+        """End an in-flight XLA trace capture (no-op otherwise)."""
+        profiler, loop_state["profiler"] = loop_state["profiler"], None
+        if profiler is not None:
+            profiler.__exit__(None, None, None)
 
     # Introspection hooks (tests assert on the compiled HLO — e.g. that
     # the MoE layout constraints actually lower to all-to-alls).
     run.jitted = jitted
     run.mesh = mesh
+    run.finish = finish
     return run
 
 

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sparktorch_tpu.parallel.compat import axis_size as _axis_size
 from sparktorch_tpu.parallel.mesh import BATCH_AXES, replicated
 from sparktorch_tpu.utils.data import DataBatch, sample_minibatch
 
@@ -259,7 +260,7 @@ def _shard_index(axis_names: Tuple[str, ...]) -> jax.Array:
     """Linearized index of this shard over the batch axes."""
     shard_id = jnp.zeros((), jnp.int32)
     for ax in axis_names:
-        shard_id = shard_id * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        shard_id = shard_id * _axis_size(ax) + jax.lax.axis_index(ax)
     return shard_id
 
 

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from sparktorch_tpu.parallel.launch import check_gang
+from sparktorch_tpu.obs import get_logger, get_telemetry
+from sparktorch_tpu.parallel.launch import check_gang, notify_gang_step
 from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, build_mesh, replicated
 from sparktorch_tpu.train.step import (
     EsConfig,
@@ -175,6 +176,7 @@ def train_distributed(
     n_micro: int = 4,
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 1,
+    telemetry=None,
 ) -> TrainResult:
     """Synchronous data-parallel training over the mesh.
 
@@ -220,8 +222,10 @@ def train_distributed(
             schedule=pipeline_schedule,
             virtual_stages=virtual_stages,
             pre_sharded=pre_sharded,
+            telemetry=telemetry,
         )
 
+    tele = telemetry or get_telemetry()
     if pre_sharded:
         # ``data`` is already a globally-sharded DataBatch (multi-host
         # path, train_distributed_multihost) — do not re-place it.
@@ -229,13 +233,15 @@ def train_distributed(
         if spec.input_shape is None:
             spec.input_shape = tuple(train_batch.x.shape[1:])
     else:
-        train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
-        if spec.input_shape is None:
-            spec.input_shape = tuple(np.asarray(train_batch.x).shape[1:])
+        with tele.span("train/data_prep"):
+            train_batch, val_batch = _as_batch(data, labels, validation_pct,
+                                               seed)
+            if spec.input_shape is None:
+                spec.input_shape = tuple(np.asarray(train_batch.x).shape[1:])
 
-        train_batch = prepare_sharded_batch(train_batch, mesh)
-        if val_batch is not None:
-            val_batch = prepare_sharded_batch(val_batch, mesh)
+            train_batch = prepare_sharded_batch(train_batch, mesh)
+            if val_batch is not None:
+                val_batch = prepare_sharded_batch(val_batch, mesh)
 
     rng = jax.random.key(seed)
     tx = spec.make_optimizer()
@@ -251,7 +257,7 @@ def train_distributed(
     # (non-fully-addressable) meshes where a host-side device_put of
     # replicated state cannot (the reference replicates the model onto
     # every executor, distributed.py:112-115).
-    with mesh:
+    with tele.span("train/init"), mesh:
         state = jax.jit(
             lambda: create_train_state(spec, rng, sample_x=sample_x, tx=tx),
             out_shardings=replicated(mesh),
@@ -317,11 +323,12 @@ def train_distributed(
     from sparktorch_tpu.utils.metrics import MetricsRecorder
     from sparktorch_tpu.utils.tracing import profile_run, step_annotation
 
-    recorder = MetricsRecorder(n_chips=mesh.size)
+    recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele)
     metrics = recorder.records
+    log = get_logger("sparktorch_tpu.train")
     last_ckpt_step = int(jax.device_get(state.step)) if ckpt is not None else 0
     shuffle_key = jax.random.key(seed + 1)
-    profiler = profile_run(profile_dir)
+    profiler = profile_run(profile_dir, telemetry=tele)
     profiler.__enter__()
     completed = False
     try:
@@ -333,7 +340,8 @@ def train_distributed(
             # otherwise feed near-single-class blocks all run.
             if shuffle_round > 0 or (mini_batch is not None and mini_batch > 0):
                 shuffle_key, sub = jax.random.split(shuffle_key)
-                train_batch = _shuffle_batch(train_batch, sub, mesh)
+                with tele.span("train/shuffle"):
+                    train_batch = _shuffle_batch(train_batch, sub, mesh)
             stop = False
             i = 0
             while i < iters:
@@ -341,12 +349,18 @@ def train_distributed(
                 # gang's heartbeat marks survivors dead within one
                 # interval). Checking here — before dispatching the next
                 # compiled chunk — means we raise GangFailure instead of
-                # wedging in the chunk's collectives.
+                # wedging in the chunk's collectives. The same spot
+                # publishes this rank's progress on its heartbeat so
+                # the driver can read cross-rank step skew.
                 check_gang()
+                notify_gang_step(i)
                 t0 = time.perf_counter()
                 if steps_per_call > 1:
                     n = min(steps_per_call, iters - i)
-                    with step_annotation(int(metrics[-1]["iter"]) + 1 if metrics else 0):
+                    with tele.span("train/step_chunk") as _chunk_span, \
+                            step_annotation(
+                                int(metrics[-1]["iter"]) + 1 if metrics else 0,
+                                telemetry=tele):
                         if fused_signals:
                             args = (((state, es_state), train_batch, val_batch)
                                     if val_batch is not None
@@ -354,6 +368,7 @@ def train_distributed(
                             (state, es_state), stacked = train_step(*args)
                         else:
                             state, stacked = train_step(state, train_batch)
+                        _chunk_span.sync(stacked.loss)
                     losses = np.asarray(stacked.loss)[:n]
                     examples = np.asarray(stacked.examples)[:n]
                     gnorms = np.asarray(stacked.grad_norm)[:n]
@@ -377,8 +392,10 @@ def train_distributed(
                                                      vals, actives, drops)
                     ]
                 else:
-                    with step_annotation(i):
+                    with tele.span("train/step") as _step_span, \
+                            step_annotation(i, telemetry=tele):
                         state, step_metrics = train_step(state, train_batch)
+                        _step_span.sync(step_metrics.loss)
                     chunk = [(
                         float(step_metrics.loss),
                         float(step_metrics.examples),
@@ -412,11 +429,13 @@ def train_distributed(
                         metrics_hook(record)
                     if verbose:
                         # Reference prints per-partition loss lines
-                        # (distributed.py:201-204); here one global line.
+                        # (distributed.py:201-204); here one global
+                        # line through the obs logger (lint-obs bans
+                        # raw prints in library code).
                         msg = f"[sparktorch_tpu] round {shuffle_round} iter {i} loss {loss:.6f}"
                         if val_loss is not None:
                             msg += f" val_loss {val_loss:.6f}"
-                        print(msg)
+                        log.info(msg)
                     # Early stop needs no collective: `loss` is already the
                     # global mean, identical on every host (vs the
                     # reference's two extra all_reduces,
@@ -430,9 +449,11 @@ def train_distributed(
                     i += 1
                 if fused_signals and bool(jax.device_get(es_state.stopped)):
                     stop = True
-                last_ckpt_step = _save_if_due(
-                    ckpt, state, last_ckpt_step, checkpoint_every
-                )
+                if ckpt is not None:
+                    with tele.span("train/checkpoint"):
+                        last_ckpt_step = _save_if_due(
+                            ckpt, state, last_ckpt_step, checkpoint_every
+                        )
                 if stop:
                     break
             if stop:
@@ -628,6 +649,7 @@ def train_distributed_streaming(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
     resume: bool = False,
+    telemetry=None,
 ) -> TrainResult:
     """Train on data LARGER than device HBM by streaming host chunks.
 
@@ -712,7 +734,10 @@ def train_distributed_streaming(
     ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
     last_ckpt_step = int(jax.device_get(state.step)) if ckpt is not None else 0
 
-    recorder = MetricsRecorder(n_chips=mesh.size)
+    tele = telemetry or get_telemetry()
+    log = get_logger("sparktorch_tpu.train")
+    recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele,
+                               prefix="train_streaming")
     # Fold the restored step into the shuffle seed: a resumed run must
     # draw FRESH permutations, not replay the epochs the interrupted
     # run already consumed.
@@ -730,13 +755,16 @@ def train_distributed_streaming(
                 # peer host dying mid-epoch must abort before the next
                 # compiled dispatch, not at the epoch boundary.
                 check_gang()
+                notify_gang_step(it_counter)
                 t0 = time.perf_counter()
-                state, metrics = step_fn(state, resident)
-                # Enqueue the NEXT chunk's host->device copy while the
-                # current chunk's (already dispatched) steps compute.
-                if ci + 1 < len(starts):
-                    resident = put_chunk(starts[ci + 1], order)
-                losses = np.asarray(metrics.loss).reshape(-1)
+                with tele.span("train_streaming/chunk"):
+                    state, metrics = step_fn(state, resident)
+                    # Enqueue the NEXT chunk's host->device copy while
+                    # the current chunk's (already dispatched) steps
+                    # compute.
+                    if ci + 1 < len(starts):
+                        resident = put_chunk(starts[ci + 1], order)
+                    losses = np.asarray(metrics.loss).reshape(-1)
                 examples = np.asarray(metrics.examples).reshape(-1)
                 dt = (time.perf_counter() - t0) / len(losses)
                 for j in range(len(losses)):
@@ -757,8 +785,8 @@ def train_distributed_streaming(
                     ckpt, state, last_ckpt_step, checkpoint_every
                 )
                 if verbose:
-                    print(f"[sparktorch_tpu] epoch {epoch} chunk {ci} "
-                          f"loss {losses[-1]:.6f}")
+                    log.info(f"[sparktorch_tpu] epoch {epoch} chunk {ci} "
+                             f"loss {losses[-1]:.6f}")
         completed = True
     finally:
         _finalize_checkpoint(ckpt, state, completed)

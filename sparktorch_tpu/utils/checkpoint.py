@@ -22,6 +22,62 @@ import jax
 import orbax.checkpoint as ocp
 
 
+def _disarm_persistent_cache_after_restore() -> None:
+    """Work around a jax-0.4.x CPU crash: executing a persistent-
+    compilation-cache DESERIALIZED executable with collectives after an
+    orbax restore has run in the same process segfaults in pxla
+    ``__call__`` (reproduced deterministically: train+save, then
+    resume — the resumed step's cache-hit executable crashes; a fresh
+    compile of the identical program is fine). Until the runtime is
+    fixed, a restore flips the persistent cache OFF for the remainder
+    of the process: everything before the first restore still gets
+    cache speed, and resumed runs pay one fresh compile instead of a
+    segfault."""
+    if jax.default_backend() != "cpu":
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", None)
+    except AttributeError:  # config knob renamed/absent on this build
+        pass
+
+
+def _is_typed_key(leaf: Any) -> bool:
+    dtype = getattr(leaf, "dtype", None)
+    return dtype is not None and jax.dtypes.issubdtype(
+        dtype, jax.dtypes.prng_key
+    )
+
+
+def _encode_keys(tree: Any) -> Any:
+    """Typed PRNG keys -> raw uint32 key data. Orbax cannot serialize
+    extended-dtype key arrays (it np.asarray's every leaf, which
+    typed keys refuse), so keys cross the checkpoint boundary as the
+    integer data jax.random.key_data extracts."""
+    return jax.tree.map(
+        lambda l: jax.random.key_data(l) if _is_typed_key(l) else l, tree
+    )
+
+
+def _encode_abstract_keys(tree: Any) -> Any:
+    """The abstract-pytree mirror of :func:`_encode_keys`: key-dtype
+    ShapeDtypeStructs become the shape/dtype of their key data, so
+    the restore target matches what save() actually wrote."""
+    return jax.tree.map(
+        lambda l: jax.eval_shape(jax.random.key_data, l)
+        if _is_typed_key(l) else l,
+        tree,
+    )
+
+
+def _decode_keys(restored: Any, abstract: Any) -> Any:
+    """Re-wrap restored key data wherever the abstract target asked
+    for a typed key (default impl — the only one the trainers use)."""
+    return jax.tree.map(
+        lambda a, r: jax.random.wrap_key_data(r) if _is_typed_key(a) else r,
+        abstract, restored,
+    )
+
 
 class CheckpointManager:
     """Thin wrapper over ``ocp.CheckpointManager`` for NamedTuple
@@ -42,7 +98,9 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(state._asdict()), force=force
+            step,
+            args=ocp.args.StandardSave(_encode_keys(state._asdict())),
+            force=force,
         )
         return bool(saved)
 
@@ -62,10 +120,13 @@ class CheckpointManager:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self._dir}")
+        abstract = abstract_state._asdict()
         restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state._asdict())
+            step,
+            args=ocp.args.StandardRestore(_encode_abstract_keys(abstract)),
         )
-        return type(abstract_state)(**restored)
+        _disarm_persistent_cache_after_restore()
+        return type(abstract_state)(**_decode_keys(restored, abstract))
 
     def wait(self):
         self._mgr.wait_until_finished()
@@ -98,4 +159,5 @@ def load_model(directory: str, abstract: Optional[Any] = None):
     if abstract is not None:
         target = {"params": abstract, "model_state": {}}
     out = ckptr.restore(os.path.join(path, "model"), target)
+    _disarm_persistent_cache_after_restore()
     return out["params"], out.get("model_state") or {}

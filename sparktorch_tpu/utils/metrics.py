@@ -5,27 +5,73 @@ gates raw ``print`` of per-partition losses (``distributed.py:201-204``,
 ``hogwild.py:133-134``; SURVEY §5 "Metrics: minimal"). This module is
 the structured replacement, shaped around the BASELINE north-star
 numbers: examples/sec/chip, mean/p50/p99 step time, loss curves.
+
+Since the telemetry subsystem landed (:mod:`sparktorch_tpu.obs`), the
+recorder is a thin adapter over the shared bus: every ``record()``
+also bumps the run's counters and step-time histogram, so existing
+call sites keep working while the same numbers surface on ``/metrics``
+and in the JSONL event stream.
 """
 
 from __future__ import annotations
 
-import json
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 
 class MetricsRecorder:
-    def __init__(self, n_chips: int = 1):
+    """Collects per-step record dicts; rolls them up into the
+    BASELINE.md protocol numbers.
+
+    ``telemetry`` (optional): a :class:`sparktorch_tpu.obs.Telemetry`
+    to mirror into — counters ``<prefix>.steps`` / ``<prefix>.examples``
+    and histogram ``<prefix>.step_s`` — so a run's recorder and its
+    ``/metrics`` view share one source of truth.
+    """
+
+    def __init__(self, n_chips: int = 1, telemetry=None,
+                 prefix: str = "train"):
         self.n_chips = max(1, n_chips)
         self.records: List[Dict[str, Any]] = []
-        self._t_start = time.perf_counter()
+        self.telemetry = telemetry
+        self.prefix = prefix
+        # Per-record wall-clock stamps (perf_counter). Wall time is
+        # last-first over THESE, not construction-to-summary: a
+        # recorder built before compilation/warmup must not charge
+        # that dead time to throughput (the old behavior inflated
+        # wall_time_s and deflated examples_per_sec).
+        self._stamps: List[float] = []
 
     def record(self, rec: Dict[str, Any]) -> None:
+        self._stamps.append(time.perf_counter())
         self.records.append(rec)
+        tele = self.telemetry
+        if tele is not None:
+            tele.counter(f"{self.prefix}.steps")
+            examples = rec.get("examples")
+            if examples:
+                tele.counter(f"{self.prefix}.examples", float(examples))
+            dt = rec.get("step_time_s")
+            if dt:
+                tele.observe(f"{self.prefix}.step_s", float(dt))
+            loss = rec.get("loss")
+            if loss is not None and np.isfinite(loss):
+                tele.gauge(f"{self.prefix}.loss", float(loss))
 
     # -- roll-ups (the BASELINE.md protocol numbers) -----------------------
+
+    def _wall_s(self) -> float:
+        """Measured span of the recorded steps: last-stamp minus
+        first-stamp, plus the first step's own duration (the first
+        stamp lands AFTER step 0 completed, so last-first alone would
+        exclude it — and would be 0 for a single-record run)."""
+        if not self._stamps:
+            return 0.0
+        wall = self._stamps[-1] - self._stamps[0]
+        first_dt = self.records[0].get("step_time_s") or 0.0
+        return wall + float(first_dt)
 
     def summary(self) -> Dict[str, Any]:
         if not self.records:
@@ -33,7 +79,7 @@ class MetricsRecorder:
         times = np.asarray([r["step_time_s"] for r in self.records
                             if r.get("step_time_s")])
         examples = float(sum(r.get("examples", 0.0) for r in self.records))
-        wall = time.perf_counter() - self._t_start
+        wall = self._wall_s()
         losses = [r["loss"] for r in self.records if r.get("loss") is not None]
         out = {
             "steps": len(self.records),
@@ -53,8 +99,15 @@ class MetricsRecorder:
             )
         return out
 
-    def to_jsonl(self, path: str) -> None:
-        with open(path, "w") as f:
-            for rec in self.records:
-                f.write(json.dumps(rec) + "\n")
-            f.write(json.dumps({"summary": self.summary()}) + "\n")
+    def to_jsonl(self, path: str, append: bool = False) -> None:
+        """Write per-step records + a summary line. Parent directories
+        are created; ``append=True`` accumulates across phases instead
+        of clobbering earlier records (multi-phase runs: warmup then
+        measure, resumed jobs, shuffle rounds)."""
+        from sparktorch_tpu.obs.sinks import write_jsonl
+
+        write_jsonl(
+            path,
+            [*self.records, {"summary": self.summary()}],
+            append=append,
+        )

@@ -1,9 +1,14 @@
-"""Profiling/tracing hooks (XLA profiler).
+"""Profiling/tracing hooks (XLA profiler), adapted over the telemetry bus.
 
 The reference ships no profiler hooks at all (SURVEY §5 "Tracing:
 none"). Here: a trace context for whole runs and per-step annotations
 that show up in the TPU trace viewer, attached at the step loop — the
 hook point the survey names (the equivalent of ``distributed.py:141``).
+
+Both hooks are thin adapters over :mod:`sparktorch_tpu.obs`: a
+profiled run records a ``tracing.profile`` span (so the trace capture
+cost itself is attributed) and step annotations bump a counter — the
+existing call-site contract is unchanged.
 """
 
 from __future__ import annotations
@@ -13,23 +18,43 @@ from typing import Iterator, Optional
 
 import jax
 
+from sparktorch_tpu.obs import get_telemetry
+
 
 @contextlib.contextmanager
-def profile_run(log_dir: Optional[str]) -> Iterator[None]:
+def profile_run(log_dir: Optional[str], telemetry=None) -> Iterator[None]:
     """Capture an XLA profiler trace for the enclosed block when
     ``log_dir`` is set; no-op otherwise. View with TensorBoard or
     xprof."""
     if not log_dir:
         yield
         return
+    import time
+
+    tele = telemetry or get_telemetry()
+    tele.counter("tracing.profile_runs")
+    # Deliberately NOT a span: a span here would sit on the thread-
+    # local stack for the whole run and re-path every trainer span
+    # underneath it — metric names must not depend on whether
+    # profiling happens to be on. A plain histogram attributes the
+    # capture's wall cost instead.
+    t0 = time.perf_counter()
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        # log_dir is NOT a label: label values must stay simple tokens
+        # (the name{k=v,...} flat-key spelling reserves ',' and '='),
+        # and a filesystem path can contain both. The trace location
+        # travels on the event instead.
+        tele.observe("tracing.profile_s", time.perf_counter() - t0)
+        tele.event("profile_trace", log_dir=log_dir)
 
 
-def step_annotation(step: int):
+def step_annotation(step: int, telemetry=None):
     """Per-step trace annotation; shows step boundaries in the trace
-    viewer."""
+    viewer. Also counts dispatched steps on the bus (one cheap counter
+    bump — safe on the hot path)."""
+    (telemetry or get_telemetry()).counter("tracing.annotated_steps")
     return jax.profiler.StepTraceAnnotation("train_step", step_num=step)

@@ -30,16 +30,29 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+if os.environ.get("SPARKTORCH_TPU_TEST_FASTCOMPILE"):
+    jax.config.update("jax_disable_most_optimizations", True)
 
-# Nearly all of the suite's wall-time is XLA recompilation of the same
-# jitted steps run-over-run; a persistent on-disk cache makes the warm
-# suite several times faster. Deliberately a different directory from
-# bench.py's TPU-side cache; within it, JAX's own cache keys (which
-# include topology/backend) keep entries from colliding.
-_CACHE_DIR = os.environ.get("SPARKTORCH_TPU_TEST_CACHE", "/tmp/sparktorch_tpu_test_cache")
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# The persistent compilation cache is OFF by default for the suite:
+# on this jax-0.4.x CPU build, EXECUTING a deserialized cached
+# executable that contains collectives segfaults/aborts in pxla
+# __call__ — same-session entries included (reproduced minimally:
+# train leg A compiles+writes, train leg B of the identical program
+# gets a cache hit and its first dispatch segfaults; cross-session
+# stale entries crash the same way). One crash kills the whole pytest
+# process, losing every remaining test — strictly worse than the
+# recompilation it saves. CheckpointManager additionally disarms a
+# runtime-enabled cache after any orbax restore (utils/checkpoint.py)
+# for non-test runs that opt in.
+# SPARKTORCH_TPU_TEST_CACHE=<dir> opts a session into a cache dir (at
+# your own risk, e.g. on a TPU backend where the bug doesn't bite).
+_CACHE_DIR = os.environ.get("SPARKTORCH_TPU_TEST_CACHE")
+if _CACHE_DIR in ("0", "off"):
+    _CACHE_DIR = None
+if _CACHE_DIR:
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import numpy as np
 import pytest

@@ -10,10 +10,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sparktorch_tpu.ops.attention import dense_attention, ring_attention
 from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from sparktorch_tpu.train.step import shard_map_compat
 
 
 def _qkv(b=2, s=32, h=4, d=16, seed=0):
@@ -29,12 +26,11 @@ def test_ring_matches_dense(causal):
 
     mesh = build_mesh(MeshConfig(dp=1, sp=8))
     spec = P(None, "sp", None, None)
-    ring = shard_map(
+    ring = shard_map_compat(
         lambda q, k, v: ring_attention(q, k, v, axis_name="sp", causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     got = jax.jit(ring)(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
@@ -44,12 +40,11 @@ def test_ring_single_device_degenerates_to_dense():
     q, k, v = _qkv(s=16)
     mesh = build_mesh(MeshConfig(dp=8, sp=1))
     spec = P(None, None, None, None)
-    ring = shard_map(
+    ring = shard_map_compat(
         lambda q, k, v: ring_attention(q, k, v, axis_name="sp", causal=True),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     got = jax.jit(ring)(q, k, v)
     want = dense_attention(q, k, v, causal=True)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from sparktorch_tpu.models import CausalLM, tiny_transformer
 from sparktorch_tpu.models.transformer import SequenceClassifier
+from sparktorch_tpu.parallel.compat import set_mesh
 from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
 from sparktorch_tpu.train.sharded import (
     create_sharded_state,
@@ -213,7 +214,7 @@ def test_moe_gspmd_ep_lowers_to_all_to_all():
         spec.make_module().apply, spec.loss_fn(), tx, mesh, shardings
     )
     batch = shard_batch(batch, mesh)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         hlo = step.jitted.lower(state, batch).compile().as_text()
     assert "all-to-all" in hlo, "no all-to-all in the ep=2 MoE step HLO"
 

@@ -1,0 +1,525 @@
+"""Unified telemetry subsystem (sparktorch_tpu.obs): spans, counters,
+histogram roll-ups, JSONL sinks, the Prometheus exporter, the param
+server's /metrics route, and gang heartbeats — plus the MetricsRecorder
+adapter contract (wall-time from record stamps, mkdir+append sinks).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sparktorch_tpu.obs import (
+    HeartbeatEmitter,
+    JsonlSink,
+    Telemetry,
+    gang_report,
+    parse_prometheus,
+    read_heartbeats,
+    read_jsonl,
+    render_prometheus,
+    write_jsonl,
+)
+from sparktorch_tpu.obs.heartbeat import HEARTBEAT_DIR_ENV  # noqa: F401
+from sparktorch_tpu.utils.metrics import MetricsRecorder
+
+
+# ---------------------------------------------------------------------------
+# Telemetry core: spans, counters, gauges, histograms
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_paths_and_timing_monotonicity():
+    tele = Telemetry(run_id="t")
+    with tele.span("outer") as outer:
+        time.sleep(0.01)
+        with tele.span("inner") as inner:
+            time.sleep(0.01)
+        assert inner.duration_s is not None
+        # Nested spans record under the slash-joined path, with depth.
+        assert inner.path == "outer/inner"
+        assert inner.depth == 1
+    assert outer.duration_s is not None
+    assert outer.depth == 0
+    # Monotonicity: the outer span strictly contains the inner one.
+    assert outer.duration_s >= inner.duration_s > 0.0
+
+    ro = tele.span_rollup("outer")
+    ri = tele.span_rollup("outer/inner")
+    assert ro["count"] == 1 and ri["count"] == 1
+    assert ro["sum"] >= ri["sum"]
+
+
+def test_span_stack_unwinds_on_exception():
+    tele = Telemetry()
+    with pytest.raises(RuntimeError):
+        with tele.span("boom"):
+            raise RuntimeError("x")
+    # The failed span still timed and the stack is clean for reuse.
+    assert tele.span_rollup("boom")["count"] == 1
+    with tele.span("after") as sp:
+        pass
+    assert sp.path == "after"  # not nested under the dead "boom"
+
+
+def test_counters_and_gauges():
+    tele = Telemetry()
+    assert tele.counter("a") == 1.0
+    assert tele.counter("a", 2.5) == 3.5
+    assert tele.counter_value("a") == 3.5
+    assert tele.counter_value("missing") == 0.0
+    with pytest.raises(ValueError):
+        tele.counter("a", -1.0)  # counters are monotonic
+    # Labeled series are distinct.
+    tele.counter("a", labels={"rank": 0})
+    assert tele.counter_value("a") == 3.5
+    assert tele.counter_value("a", labels={"rank": 0}) == 1.0
+    tele.gauge("g", 7.0)
+    tele.gauge("g", 3.0)  # last write wins
+    assert tele.gauge_value("g") == 3.0
+    assert tele.gauge_value("missing") is None
+
+
+def test_histogram_rollups_empty_single_and_many():
+    tele = Telemetry()
+    # Empty: count=0, null quantiles, never raises.
+    empty = tele.histogram("nope")
+    assert empty["count"] == 0 and empty["p50"] is None
+
+    # Single sample: every percentile IS the sample.
+    tele.observe("one", 4.0)
+    one = tele.histogram("one")
+    assert one["count"] == 1
+    assert one["p50"] == one["p95"] == one["p99"] == 4.0
+    assert one["min"] == one["max"] == 4.0 and one["sum"] == 4.0
+
+    # Many samples: exact streaming aggregates + sane percentiles.
+    for v in range(1, 101):
+        tele.observe("many", float(v))
+    many = tele.histogram("many")
+    assert many["count"] == 100 and many["sum"] == 5050.0
+    assert many["min"] == 1.0 and many["max"] == 100.0
+    assert 49.0 <= many["p50"] <= 52.0
+    assert 94.0 <= many["p95"] <= 96.0
+    assert many["p95"] <= many["p99"] <= 100.0
+
+
+def test_histogram_ring_bounds_memory_but_keeps_exact_aggregates():
+    tele = Telemetry(ring_size=8)
+    for v in range(1000):
+        tele.observe("h", float(v))
+    roll = tele.histogram("h")
+    # Exact streaming stats over ALL samples...
+    assert roll["count"] == 1000
+    assert roll["min"] == 0.0 and roll["max"] == 999.0
+    # ...percentiles from the recent ring (the last 8 values).
+    assert roll["p50"] >= 992.0
+
+
+def test_snapshot_one_source_of_truth_and_events():
+    tele = Telemetry(run_id="snap")
+    events = []
+    tele.add_sink(events.append)
+    tele.counter("c", 2.0)
+    tele.gauge("g", 1.5)
+    tele.observe("h", 0.25)
+    with tele.span("s"):
+        pass
+    snap = tele.snapshot()
+    assert snap["run_id"] == "snap"
+    assert snap["counters"]["c"] == 2.0
+    assert snap["gauges"]["g"] == 1.5
+    assert snap["histograms"]["h"]["count"] == 1
+    assert snap["spans"]["s"]["count"] == 1
+    # Span completion emitted one structured event to the sink.
+    kinds = [e["kind"] for e in events]
+    assert "span" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Sinks: directories created, append semantics, torn-line tolerance
+# ---------------------------------------------------------------------------
+
+
+def test_write_jsonl_creates_dirs_and_appends(tmp_path):
+    path = str(tmp_path / "deep" / "nested" / "log.jsonl")
+    assert write_jsonl(path, [{"a": 1}]) == 1
+    assert write_jsonl(path, [{"a": 2}], append=True) == 1
+    assert [r["a"] for r in read_jsonl(path)] == [1, 2]
+    # append=False clobbers (the explicit opt-out).
+    write_jsonl(path, [{"a": 3}])
+    assert [r["a"] for r in read_jsonl(path)] == [3]
+
+
+def test_read_jsonl_skips_torn_final_line(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"ok": 1}) + "\n")
+        f.write('{"torn": tru')  # killed mid-write
+    assert read_jsonl(path) == [{"ok": 1}]
+
+
+def test_jsonl_sink_streams_events(tmp_path):
+    path = str(tmp_path / "ev" / "events.jsonl")
+    tele = Telemetry(run_id="r1")
+    sink = tele.add_jsonl_sink(path)
+    with tele.span("phase"):
+        pass
+    tele.event("custom", value=42)
+    sink.close()
+    recs = read_jsonl(path)
+    assert {r["kind"] for r in recs} == {"span", "custom"}
+    assert all(r["run_id"] == "r1" for r in recs)
+    # close() detached the sink: further events don't raise or write.
+    tele.event("after_close")
+    assert len(read_jsonl(path)) == len(recs)
+    # A second sink on the same path APPENDS by default (multi-phase).
+    sink2 = tele.add_jsonl_sink(path)
+    tele.event("phase2")
+    sink2.close()
+    assert len(read_jsonl(path)) == len(recs) + 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exporter
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_rendering_and_roundtrip():
+    tele = Telemetry()
+    tele.counter("train.steps", 5)
+    tele.counter("http_requests", labels={"route": "/metrics"})
+    tele.gauge("queue_depth", 3)
+    for v in (0.1, 0.2, 0.3):
+        tele.observe("step_s", v)
+    text = render_prometheus(tele.snapshot())
+    assert text.endswith("\n")
+    # Names are sanitized to the Prometheus charset and namespaced.
+    assert "sparktorch_train_steps 5.0" in text
+    assert 'sparktorch_http_requests{route="/metrics"} 1.0' in text
+    assert "# TYPE sparktorch_train_steps counter" in text
+    assert "# TYPE sparktorch_queue_depth gauge" in text
+    assert "# TYPE sparktorch_step_s summary" in text
+    assert "sparktorch_step_s_count 3.0" in text
+    parsed = parse_prometheus(text)
+    assert parsed["sparktorch_train_steps"] == 5.0
+    assert parsed["sparktorch_queue_depth"] == 3.0
+    assert parsed['sparktorch_step_s{quantile="0.5"}'] == pytest.approx(0.2)
+    assert parsed["sparktorch_step_s_sum"] == pytest.approx(0.6)
+
+
+def test_prometheus_empty_snapshot_and_label_escaping():
+    assert render_prometheus(Telemetry().snapshot()) == "\n"
+    tele = Telemetry()
+    tele.counter("c", labels={"path": 'a"b\\c'})
+    text = render_prometheus(tele.snapshot())
+    assert r'path="a\"b\\c"' in text
+
+
+# ---------------------------------------------------------------------------
+# MetricsRecorder as a bus adapter (satellites 1 + 2)
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_wall_time_excludes_pre_record_dead_time():
+    rec = MetricsRecorder(n_chips=1)
+    # Dead time between construction and the first record (compilation,
+    # warmup) must NOT be charged to throughput.
+    time.sleep(0.25)
+    rec.record({"loss": 1.0, "examples": 100.0, "step_time_s": 0.01})
+    rec.record({"loss": 0.9, "examples": 100.0, "step_time_s": 0.01})
+    s = rec.summary()
+    assert s["steps"] == 2
+    # Wall is the measured span of the records (plus step 0's own
+    # duration), nowhere near the 0.25s of pre-record dead time.
+    assert s["wall_time_s"] < 0.2
+    assert s["examples_per_sec"] > 1000.0
+
+
+def test_recorder_single_record_wall_is_step_time():
+    rec = MetricsRecorder()
+    rec.record({"loss": 1.0, "examples": 50.0, "step_time_s": 0.05})
+    s = rec.summary()
+    # One record: last-first is 0, so wall falls back to the step's own
+    # duration instead of reporting zero/infinite throughput.
+    assert s["wall_time_s"] == pytest.approx(0.05, rel=0.2)
+    assert s["examples_per_sec"] == pytest.approx(1000.0, rel=0.2)
+
+
+def test_recorder_mirrors_into_telemetry():
+    tele = Telemetry()
+    rec = MetricsRecorder(n_chips=2, telemetry=tele, prefix="train")
+    rec.record({"loss": 0.5, "examples": 64.0, "step_time_s": 0.02})
+    rec.record({"loss": 0.4, "examples": 64.0, "step_time_s": 0.03})
+    assert tele.counter_value("train.steps") == 2.0
+    assert tele.counter_value("train.examples") == 128.0
+    assert tele.histogram("train.step_s")["count"] == 2
+    assert tele.gauge_value("train.loss") == 0.4
+
+
+def test_recorder_to_jsonl_mkdirs_and_append(tmp_path):
+    rec = MetricsRecorder()
+    rec.record({"loss": 1.0, "examples": 10.0, "step_time_s": 0.01})
+    path = str(tmp_path / "made" / "by" / "recorder" / "m.jsonl")
+    rec.to_jsonl(path)  # parent dirs created on demand
+    first = read_jsonl(path)
+    assert len(first) == 2  # one record + the summary line
+    rec.to_jsonl(path, append=True)  # phase 2 accumulates
+    assert len(read_jsonl(path)) == 4
+    rec.to_jsonl(path)  # default overwrites (single-phase contract)
+    assert len(read_jsonl(path)) == 2
+
+
+# ---------------------------------------------------------------------------
+# Param server /metrics round-trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def payload():
+    from sparktorch_tpu import serialize_torch_obj
+    from sparktorch_tpu.models import Net
+
+    return serialize_torch_obj(
+        Net(), criterion="mse", optimizer="adam",
+        optimizer_params={"lr": 5e-3}, input_shape=(10,),
+    )
+
+
+def test_param_server_metrics_route_matches_jsonl_dump(payload, tmp_path):
+    import jax
+
+    from sparktorch_tpu.serve.param_server import (
+        ParameterServer,
+        ParamServerHttp,
+    )
+
+    tele = Telemetry(run_id="ps-test")
+    server = ParameterServer(payload, window_len=1, telemetry=tele)
+    http = None
+    try:
+        http = ParamServerHttp(server, port=0).start()
+        # Drive real traffic: versioned pull, gradient push + apply.
+        v0, params = server.get_parameters(-1)
+        assert server.get_parameters(v0) is None
+        grads = jax.tree.map(lambda a: np.ones_like(np.asarray(a)), params)
+        server.push_gradients(grads)
+        server.drain()
+
+        with urllib.request.urlopen(http.url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            ctype = r.headers.get("Content-Type", "")
+            assert ctype.startswith("text/plain")
+            scraped = parse_prometheus(r.read().decode())
+
+        # The same Telemetry.snapshot() feeds the JSONL dump: every
+        # counter the scrape saw must match the dump (modulo the
+        # /metrics request counter itself, which the scrape bumped).
+        dump_path = str(tmp_path / "obs" / "ps.jsonl")
+        snap = tele.dump(dump_path)
+        (line,) = read_jsonl(dump_path)
+        assert line["kind"] == "snapshot"
+        assert line["counters"] == snap["counters"]
+        assert scraped["sparktorch_param_server_pulls"] == snap["counters"][
+            "param_server.pulls"
+        ] == 2.0
+        assert scraped["sparktorch_param_server_pull_fresh"] == 1.0
+        assert scraped["sparktorch_param_server_pushes"] == 1.0
+        assert scraped["sparktorch_param_server_applies"] == 1.0
+        assert (
+            scraped['sparktorch_param_server_http_requests{route="/metrics"}']
+            == 1.0
+        )
+        # Apply latency surfaced as a summary with count/sum.
+        assert scraped["sparktorch_param_server_apply_s_count"] == 1.0
+
+        # /telemetry serves the identical snapshot as JSON.
+        with urllib.request.urlopen(http.url + "/telemetry", timeout=10) as r:
+            body = json.loads(r.read().decode())
+        assert body["counters"]["param_server.pulls"] == 2.0
+    finally:
+        if http is not None:
+            http.stop()
+        server.stop()
+
+
+def test_hogwild_run_records_on_shared_bus(payload):
+    from sparktorch_tpu.train.hogwild import train_async
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (64, 10)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    tele = Telemetry(run_id="hogwild-test")
+    result = train_async(payload, x, labels=y, iters=4, partitions=2,
+                         mini_batch=16, seed=0, telemetry=tele)
+    assert result.metrics
+    # Workers and the server recorded into the SAME run-scoped bus.
+    snap = tele.snapshot()
+    worker_iters = sum(
+        v for k, v in snap["counters"].items() if k.startswith("hogwild.iters")
+    )
+    assert worker_iters == 8.0  # 2 workers x 4 iters
+    assert snap["counters"]["param_server.pushes"] == 8.0
+    assert snap["counters"]["param_server.applies"] == 8.0
+    assert snap["counters"]["hogwild.rounds"] == 1.0
+    assert snap["histograms"]["hogwild.round_s"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer tracing hooks (sharded GSPMD path)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_step_tracing_and_telemetry(tmp_path):
+    """make_sharded_train_step accepts the same profile_dir contract
+    as the other trainers: per-call step annotations + spans on the
+    bus, an XLA trace captured from the first call until finish()."""
+    import jax
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+    from sparktorch_tpu.train.sharded import (
+        create_sharded_state,
+        make_sharded_train_step,
+        shard_batch,
+    )
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    batch = DataBatch(
+        x=np.asarray(rng.integers(0, 256, (8, 16)).astype(np.int32)),
+        y=np.asarray(rng.integers(0, 2, (8,)).astype(np.int32)),
+        w=np.ones((8,), np.float32),
+    )
+    mesh = build_mesh(MeshConfig(dp=8))
+    module = SequenceClassifier(tiny_transformer())
+    spec = ModelSpec(module=module, loss="cross_entropy", optimizer="adam",
+                     optimizer_params={"lr": 1e-3})
+    tx = spec.make_optimizer()
+    state, shardings = create_sharded_state(
+        spec, mesh, jax.random.key(0), sample_x=batch.x[:1], tx=tx,
+    )
+    tele = Telemetry()
+    profile_dir = str(tmp_path / "trace")
+    step = make_sharded_train_step(
+        module.apply, spec.loss_fn(), tx, mesh, shardings,
+        profile_dir=profile_dir, telemetry=tele,
+    )
+    sharded = shard_batch(batch, mesh)
+    for _ in range(2):
+        state, metrics = step(state, sharded)
+    assert np.isfinite(float(metrics.loss))
+    step.finish()
+    step.finish()  # idempotent
+
+    assert tele.span_rollup("train_sharded/step")["count"] == 2
+    assert tele.counter_value("tracing.annotated_steps") == 2.0
+    assert tele.counter_value("tracing.profile_runs") == 1.0
+    # log_dir rides the profile_trace EVENT, not a label (paths can
+    # contain the flat-key delimiters ',' and '=').
+    assert tele.histogram("tracing.profile_s")["count"] == 1
+    # The XLA profiler actually wrote a capture.
+    captured = [os.path.join(d, f) for d, _, fs in os.walk(profile_dir)
+                for f in fs]
+    assert captured, "no trace files written"
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_emit_read_and_report(tmp_path):
+    hb_dir = str(tmp_path / "hb")  # created by the emitter
+    tele = Telemetry()
+    h0 = HeartbeatEmitter(hb_dir, rank=0, host="hostA", telemetry=tele)
+    h1 = HeartbeatEmitter(hb_dir, rank=1, host="hostB")
+    h0.beat()
+    h1.notify_step(3)
+    h0.notify_step(7)
+
+    beats = read_heartbeats(hb_dir)
+    assert [b["rank"] for b in beats] == [0, 1]
+    assert beats[0]["host"] == "hostA" and beats[1]["host"] == "hostB"
+
+    report = gang_report(hb_dir)
+    assert report["n_ranks"] == 2
+    assert report["alive"] == [0, 1]
+    assert report["step_min"] == 3 and report["step_max"] == 7
+    assert report["step_skew"] == 4
+    assert report["ranks"][0]["last_seen_age_s"] >= 0.0
+
+    # Mirrored onto the bus with rank/host labels.
+    assert tele.counter_value(
+        "gang.heartbeats", labels={"rank": 0, "host": "hostA"}
+    ) == 2.0
+    assert tele.gauge_value(
+        "gang.step", labels={"rank": 0, "host": "hostA"}
+    ) == 7.0
+
+    # Clean shutdown is readable: alive=False, distinct from silence.
+    h1.close()
+    report = gang_report(hb_dir)
+    assert report["alive"] == [0]
+    assert report["ranks"][1]["alive"] is False
+
+
+def test_gang_worker_heartbeat_integration(tmp_path):
+    """Real GangWorkers (native coordinator, heartbeat threads ON)
+    with a heartbeat directory: the attributed liveness rides the
+    native heartbeat cadence, notify_step publishes progress, and
+    close() is ordered so the final alive=False beat cannot be
+    overwritten by a late alive=True tick from the heartbeat thread."""
+    import threading
+
+    from sparktorch_tpu.native.gang import GangCoordinator, GangWorker
+
+    hb_dir = str(tmp_path / "gang_hb")
+    with GangCoordinator(world_size=2) as coord:
+        workers = {}
+
+        def run(rank):
+            w = GangWorker("127.0.0.1", coord.port, rank,
+                           f"10.0.0.{rank}:8476", heartbeat_dir=hb_dir,
+                           heartbeat_interval_s=0.05)
+            workers[rank] = w
+            w.barrier(0)
+            w.heartbeat.notify_step(3 - rank)  # rank 1 lags: skew 1
+
+        threads = [threading.Thread(target=run, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+
+        report = gang_report(hb_dir)
+        assert report["n_ranks"] == 2 and report["alive"] == [0, 1]
+        assert report["step_skew"] == 1
+        assert report["ranks"][0]["step"] == 3
+
+        for w in workers.values():
+            w.close()
+        # After close, BOTH read alive=False — deterministically: the
+        # heartbeat thread is joined before the final beat lands.
+        report = gang_report(hb_dir)
+        assert report["alive"] == []
+        assert all(not v["alive"] for v in report["ranks"].values())
+
+
+def test_heartbeat_report_tolerates_torn_and_foreign_files(tmp_path):
+    hb_dir = str(tmp_path / "hb2")
+    HeartbeatEmitter(hb_dir, rank=0).beat()
+    with open(os.path.join(hb_dir, "gang_hb_rank9.json"), "w") as f:
+        f.write('{"rank": 9, "torn"')  # killed mid-write
+    with open(os.path.join(hb_dir, "unrelated.txt"), "w") as f:
+        f.write("not a heartbeat")
+    report = gang_report(hb_dir)
+    assert report["n_ranks"] == 1  # torn + foreign skipped, not fatal
+    assert gang_report(str(tmp_path / "missing")) == {
+        "n_ranks": 0, "ranks": {}, "alive": [],
+    }

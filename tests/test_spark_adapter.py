@@ -170,12 +170,16 @@ def test_barrier_mode_two_process_world(data):
     assert acc > 0.9, acc
 
 
-def _gang_train_lm(spark, cfg, **train_kwargs):
+def _gang_train_lm(spark, cfg, heartbeat_dir=None, **train_kwargs):
     """Shared scaffold for the 2-process barrier LM trainings: build a
     16-row token frame, gang-launch a 2-task barrier stage, bring up
     the 2-process jax.distributed world, train over a dp=8 x pp=2 mesh
     with ``train_distributed_multihost`` (pre-sharded global batch),
-    and return rank 0's per-iteration metrics dicts."""
+    and return rank 0's per-iteration metrics dicts.
+
+    ``heartbeat_dir`` (optional): enable rank/host-attributed gang
+    heartbeats (obs.heartbeat) in every executor process, publishing
+    into the shared directory the driver can read back."""
     import numpy as _np
 
     from sparktorch_tpu.models import CausalLM
@@ -192,6 +196,8 @@ def _gang_train_lm(spark, cfg, **train_kwargs):
     gang_port = coord.port
 
     def run_host(iterator):
+        import os
+
         import numpy as np
         from pyspark import BarrierTaskContext
 
@@ -200,6 +206,13 @@ def _gang_train_lm(spark, cfg, **train_kwargs):
         toks = np.stack([
             np.asarray(r[0].toArray(), np.int64) for r in iterator
         ]).astype(np.int32)
+
+        if heartbeat_dir:
+            # Enable attributed heartbeats in THIS executor process:
+            # GangWorker picks the directory up at construction.
+            from sparktorch_tpu.obs import HEARTBEAT_DIR_ENV
+
+            os.environ[HEARTBEAT_DIR_ENV] = heartbeat_dir
 
         from sparktorch_tpu.parallel.launch import bringup_multihost
         from sparktorch_tpu.train.sync import train_distributed_multihost
@@ -275,6 +288,89 @@ def test_barrier_two_process_interleaved_moe(spark):
     assert all(_np.isfinite(losses))
     assert losses[-1] < losses[0], losses
     assert drops[0] is not None and _np.isfinite(drops[0])
+
+
+def test_barrier_two_process_gang_heartbeats(spark, tmp_path):
+    """Gang heartbeat smoke test (obs.heartbeat) under the 2-process
+    barrier scaffold: two executor PROCESSES rendezvous through the
+    native gang coordinator with SPARKTORCH_TPU_HEARTBEAT_DIR set,
+    publish attributed liveness (rank, host, pid, training step,
+    last-seen ts) through the real trainer path
+    (register_gang_worker + notify_gang_step), and the driver-side
+    ``gang_report`` derives per-rank step skew and reads the clean
+    shutdown as alive=False — distinct from a silent death.
+
+    Deliberately no jax.distributed training: this jaxlib's CPU
+    backend can't run multiprocess computations (the slow barrier
+    trainings above document that), and liveness/skew attribution
+    must be testable without it anyway — that's its whole point."""
+    from sparktorch_tpu.native.gang import GangCoordinator
+    from sparktorch_tpu.obs import gang_report, read_heartbeats
+
+    hb_dir = str(tmp_path / "gang_hb")
+    rng = np.random.default_rng(0)
+    rows = [(float(i), DenseVector(rng.normal(0, 1, 4))) for i in range(8)]
+    df = spark.createDataFrame(rows, ["idx", "features"]).repartition(2)
+
+    coord = GangCoordinator(world_size=2, port=0)
+    gang_port = coord.port
+
+    def run_host(iterator):
+        import os
+
+        from pyspark import BarrierTaskContext
+
+        from sparktorch_tpu.native.gang import GangWorker
+        from sparktorch_tpu.parallel.launch import (
+            notify_gang_step,
+            register_gang_worker,
+        )
+
+        rank = BarrierTaskContext.get().partitionId()
+        os.environ["SPARKTORCH_TPU_HEARTBEAT_DIR"] = hb_dir
+        worker = GangWorker("127.0.0.1", gang_port, rank,
+                            f"127.0.0.1:{9000 + rank}")
+        try:
+            worker.barrier(0)  # full gang assembled
+            register_gang_worker(worker)
+            # The trainer cadence: one progress publish per dispatched
+            # step. Rank 1 lags one step behind — measurable skew.
+            last = 3 - rank
+            for step in range(last + 1):
+                notify_gang_step(step)
+            yield {"rank": rank, "pid": os.getpid(), "last_step": last}
+        finally:
+            worker.close()  # final alive=False beat (clean shutdown)
+
+    try:
+        out = df.rdd.barrier().mapPartitions(run_host).collect()
+    finally:
+        coord.stop()
+
+    assert len(out) == 2 and {o["rank"] for o in out} == {0, 1}
+
+    beats = read_heartbeats(hb_dir)
+    assert [b["rank"] for b in beats] == [0, 1]
+    # Two real PROCESSES, each attributed with host + pid.
+    assert beats[0]["pid"] != beats[1]["pid"]
+    assert {b["pid"] for b in beats} == {o["pid"] for o in out}
+    assert all(b["host"] for b in beats)
+    # One beat per published step + the final shutdown beat.
+    assert beats[0]["beats"] >= 5 and beats[1]["beats"] >= 4
+
+    report = gang_report(hb_dir)
+    assert report["n_ranks"] == 2
+    # Per-rank training progress and the derived cross-rank step skew.
+    assert report["ranks"][0]["step"] == 3
+    assert report["ranks"][1]["step"] == 2
+    assert report["step_min"] == 2 and report["step_max"] == 3
+    assert report["step_skew"] == 1
+    for rank in (0, 1):
+        assert report["ranks"][rank]["last_seen_age_s"] >= 0.0
+        # worker.close() emitted the final alive=False beat — a CLEAN
+        # shutdown, not a silent death (which would age alive=True).
+        assert report["ranks"][rank]["alive"] is False
+    assert report["alive"] == []
 
 
 @pytest.mark.slow
