@@ -5,7 +5,7 @@
 PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
-	cluster-up clean lint-obs
+	bench-wire cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -14,10 +14,12 @@ install:
 # sparktorch_tpu.obs (spans/counters/JSONL//metrics), human lines
 # through obs.log.get_logger. The reference's print-based story
 # (distributed.py:201-204, hogwild.py:133-134) must not creep back in.
-# bench.py is the CLI — its stdout JSON lines are its contract.
+# bench.py and net/bench_wire.py are CLIs — their stdout JSON lines
+# are their contract.
 lint-obs:
 	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
-		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:'); \
+		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:' \
+		| grep -v '^sparktorch_tpu/net/bench_wire\.py:'); \
 	if [ -n "$$hits" ]; then \
 		echo "lint-obs: raw print() in library code (use obs.get_logger):"; \
 		echo "$$hits"; exit 1; \
@@ -53,6 +55,13 @@ bench:
 
 bench-all:
 	$(PYTHON) -m sparktorch_tpu.bench --config all --log benchmarks/bench_local.jsonl
+
+# Dill-vs-binary wire microbenchmark (transformer-sized state dict):
+# FAILS unless the framed binary wire beats dill on both bytes on the
+# wire and encode+decode wall time — the zero-copy claim, gated.
+# Non-default CI-style smoke target (no TPU or JAX device needed).
+bench-wire:
+	$(PYTHON) -m sparktorch_tpu.net.bench_wire
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

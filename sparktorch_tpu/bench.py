@@ -11,7 +11,8 @@ project's measured baselines. BASELINE.json configs:
 
 Extensions beyond the reference's scope: mnist_cnn_sync (the headline),
 long_context_lm (flash kernels at seq 8192), moe_lm (switch MoE vs its
-dense twin).
+dense twin), hogwild_wire (dill vs framed-binary parameter-server wire
+on real sockets).
 
 Each bench returns a summary dict (examples/sec/chip + p50/p99 step
 times where steps exist) and appends raw per-phase records to a JSONL
@@ -305,16 +306,20 @@ def bench_resnet18_hogwild() -> dict:
     import jax
 
     from sparktorch_tpu.models.resnet import resnet18
+    from sparktorch_tpu.obs import get_telemetry
     from sparktorch_tpu.train.hogwild import train_async
     from sparktorch_tpu.utils.serde import ModelSpec
 
-    rng = np.random.default_rng(0)
-    n, mb = 2048, 256
-    x = rng.normal(0, 1, (n, 32, 32, 3)).astype(np.float32)
-    y = rng.integers(0, 10, (n,)).astype(np.int32)
-    spec = ModelSpec(module=resnet18(num_classes=10), loss="cross_entropy",
-                     optimizer="sgd", optimizer_params={"lr": 1e-2},
-                     input_shape=(32, 32, 3))
+    tele = get_telemetry()
+    with tele.span("bench/data") as _sp_data:
+        rng = np.random.default_rng(0)
+        n, mb = 2048, 256
+        x = rng.normal(0, 1, (n, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, (n,)).astype(np.int32)
+    with tele.span("bench/init") as _sp_init:
+        spec = ModelSpec(module=resnet18(num_classes=10), loss="cross_entropy",
+                         optimizer="sgd", optimizer_params={"lr": 1e-2},
+                         input_shape=(32, 32, 3))
     # push_every=4: the accumulation knob is part of the async design
     # (k on-device grad means per server apply — wire/apply traffic
     # drops 4x, the same examples train).
@@ -323,7 +328,8 @@ def bench_resnet18_hogwild() -> dict:
     # builds fresh jitted closures per call, so this relies on the
     # persistent compilation cache (enabled in main()) to make the
     # measured runs compile-free.
-    train_async(spec, x, labels=y, iters=8, mini_batch=mb, push_every=4)
+    with tele.span("bench/compile_warmup") as _sp_warm:
+        train_async(spec, x, labels=y, iters=8, mini_batch=mb, push_every=4)
 
     def _one_run(transport: str = "local",
                  run_iters: int = iters) -> tuple[float, dict, dict]:
@@ -361,15 +367,36 @@ def bench_resnet18_hogwild() -> dict:
     # regression is distinguishable from run-to-run variance. The
     # auxiliary stats come from the median run so they can't
     # contradict the headline rate.
-    runs = sorted([_one_run() for _ in range(5)], key=lambda r: r[0])
-    rates = [r[0] for r in runs]
-    per_chip, info, budget = runs[len(runs) // 2]
-    spread_pct = 100.0 * (rates[-1] - rates[0]) / max(
-        rates[len(rates) // 2], 1e-9
-    )
-    times = [info["dt"] / max(1, info["iters_recorded"])] * max(
-        1, info["iters_recorded"]
-    )
+    with tele.span("bench/measure") as _sp_measure:
+        runs = sorted([_one_run() for _ in range(5)], key=lambda r: r[0])
+        rates = [r[0] for r in runs]
+        per_chip, info, budget = runs[len(runs) // 2]
+        spread_pct = 100.0 * (rates[-1] - rates[0]) / max(
+            rates[len(rates) // 2], 1e-9
+        )
+        times = [info["dt"] / max(1, info["iters_recorded"])] * max(
+            1, info["iters_recorded"]
+        )
+
+        # Wire ablation: the same workload over the HTTP transport
+        # (the deployment wire; binary frames by default since the
+        # net/ subsystem landed). local-vs-http separates the DESIGN
+        # overhead (server round-trips, pull placement, materialize
+        # fences) from the WIRE itself. Fault-isolated: a tunnel
+        # trough stalling a 45 MB pull past even the generous deadline
+        # must not discard the already-measured local numbers — the
+        # failure is recorded instead.
+        try:
+            http_rate, _, http_budget = _one_run(
+                transport="http", run_iters=max(64, iters // 4))
+            http_error = None
+        except Exception as e:
+            http_rate, http_budget = 0.0, {}
+            http_error = f"{type(e).__name__}: {e}"
+            if e.__cause__ is not None:  # the worker's root failure
+                http_error += (f" (from {type(e.__cause__).__name__}: "
+                               f"{e.__cause__})")
+            http_error = http_error[:300]
 
     # The decomposition the efficiency ratio owes: where the median
     # run's worker wall time went, as fractions that sum to ~1
@@ -394,25 +421,6 @@ def bench_resnet18_hogwild() -> dict:
             "pulls": int(budget.get("pulls", 0)),
             "pull_fresh": int(budget.get("pull_fresh", 0)),
         }
-
-    # Wire ablation: the same workload over the HTTP transport (the
-    # reference's deployment wire). local-vs-http separates the DESIGN
-    # overhead (server round-trips, pull placement, materialize
-    # fences) from the WIRE itself. Fault-isolated: a tunnel trough
-    # stalling a 45 MB pull past even the generous deadline must not
-    # discard the already-measured local numbers — the failure is
-    # recorded instead.
-    try:
-        http_rate, _, http_budget = _one_run(transport="http",
-                                             run_iters=max(64, iters // 4))
-        http_error = None
-    except Exception as e:
-        http_rate, http_budget = 0.0, {}
-        http_error = f"{type(e).__name__}: {e}"
-        if e.__cause__ is not None:  # the worker's root failure
-            http_error += (f" (from {type(e.__cause__).__name__}: "
-                           f"{e.__cause__})")
-        http_error = http_error[:300]
 
     # Sync twin at the same PER-CHIP batch: each hogwild worker
     # computes 256-row minibatches, so the sync leg runs 256 rows per
@@ -447,7 +455,109 @@ def bench_resnet18_hogwild() -> dict:
         ),
         **({"http_ablation_error": http_error} if http_error else {}),
         **budget_rec,
+        # Same decomposition contract as _sync_epoch_bench, from this
+        # config's own bus spans (the sync twin reports its own
+        # phase_s inside `sync_*`; it runs outside the measure span so
+        # its nested spans keep their canonical bench/* paths).
+        "phase_s": {
+            "data": round(_sp_data.duration_s, 3),
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+            "sync_twin": round(sum(sync["phase_s"].values()), 3),
+        },
         **_steps_summary(times),
+    }
+
+
+def bench_hogwild_wire() -> dict:
+    """Wire ablation: the SAME hogwild workload over the dill wire vs
+    the framed binary wire (net/), both on real sockets. The headline
+    numbers are per-operation: seconds and bytes per push and per
+    fresh pull, which is what the wire change actually buys — the
+    end-to-end rate also rides along. ``phase_s`` carries both the
+    standard data/init/compile_warmup/measure decomposition and the
+    pull/push budget of each wire (the hot-path seconds the ISSUE's
+    acceptance names)."""
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.obs import get_telemetry
+    from sparktorch_tpu.train.hogwild import train_async
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    tele = get_telemetry()
+    with tele.span("bench/data") as _sp_data:
+        rng = np.random.default_rng(0)
+        n, mb = 2048, 256
+        x = rng.normal(0, 1, (n, 784)).astype(np.float32)
+        y = rng.integers(0, 10, (n,)).astype(np.int32)
+    with tele.span("bench/init") as _sp_init:
+        spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                         optimizer="adam", optimizer_params={"lr": 1e-3},
+                         input_shape=(784,))
+    with tele.span("bench/compile_warmup") as _sp_warm:
+        # Same shapes/window as the measured runs: the persistent
+        # compile cache (enabled in main()) makes them compile-free.
+        train_async(spec, x, labels=y, iters=8, mini_batch=mb,
+                    push_every=4)
+
+    iters = 128
+    wires: Dict[str, dict] = {}
+    with tele.span("bench/measure") as _sp_measure:
+        for wire_fmt in ("dill", "binary"):
+            t0 = time.perf_counter()
+            result = train_async(spec, x, labels=y, iters=iters,
+                                 mini_batch=mb, push_every=4,
+                                 transport="http", wire=wire_fmt, seed=0)
+            wall = time.perf_counter() - t0
+            b = (result.summary or {}).get("hogwild_budget", {})
+            pushes = max(1, int(b.get("pushes", 0)))
+            fresh = max(1, int(b.get("pull_fresh", 0)))
+            wires[wire_fmt] = {
+                "wall_s": round(wall, 3),
+                "pull_s": round(b.get("pull_s", 0.0), 4),
+                "push_wire_s": round(b.get("push_wire_s", 0.0), 4),
+                "push_materialize_s": round(
+                    b.get("push_materialize_s", 0.0), 4),
+                "pull_mb": round(b.get("pull_bytes", 0) / 1e6, 3),
+                "push_mb": round(b.get("push_bytes", 0) / 1e6, 3),
+                "pulls": int(b.get("pulls", 0)),
+                "pull_fresh": int(b.get("pull_fresh", 0)),
+                "pushes": int(b.get("pushes", 0)),
+                "push_wire_s_per_push": round(
+                    b.get("push_wire_s", 0.0) / pushes, 5),
+                "pull_s_per_fresh_pull": round(
+                    b.get("pull_s", 0.0) / fresh, 5),
+                # Steps = pushes x push_every (device count varies by
+                # rig; the budget's own push count doesn't).
+                "push_bytes_per_step": round(
+                    b.get("push_bytes", 0)
+                    / max(1, int(b.get("pushes", 0)) * 4), 1),
+                "final_loss": result.metrics[-1]["loss"],
+            }
+
+    d, bn = wires["dill"], wires["binary"]
+    return {
+        "config": "hogwild_wire", "unit": "s/push",
+        "value": bn["push_wire_s_per_push"],
+        "binary": bn, "dill": d,
+        "push_bytes_ratio_dill_over_binary": round(
+            d["push_mb"] / max(bn["push_mb"], 1e-9), 3),
+        "pull_bytes_ratio_dill_over_binary": round(
+            d["pull_mb"] / max(bn["pull_mb"], 1e-9), 3),
+        "push_wire_speedup": round(
+            d["push_wire_s_per_push"]
+            / max(bn["push_wire_s_per_push"], 1e-9), 3),
+        "phase_s": {
+            "data": round(_sp_data.duration_s, 3),
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+            # The hot-path budget the wire change targets, per wire.
+            "pull": round(bn["pull_s"], 4),
+            "push": round(bn["push_wire_s"] + bn["push_materialize_s"], 4),
+            "pull_dill": round(d["pull_s"], 4),
+            "push_dill": round(d["push_wire_s"] + d["push_materialize_s"], 4),
+        },
     }
 
 
@@ -595,53 +705,70 @@ def bench_resnet50_inference() -> dict:
         write_rows_parquet,
     )
     from sparktorch_tpu.models.resnet import resnet50
+    from sparktorch_tpu.obs import get_telemetry
 
+    tele = get_telemetry()
     module = resnet50()
     rng = np.random.default_rng(0)
     chunk = 256
-    variables = module.init(jax.random.key(0),
-                            np.zeros((1, 224, 224, 3), np.float32))
-    predictor = BatchPredictor(
-        module, variables["params"],
-        {k: v for k, v in variables.items() if k != "params"},
-        chunk=chunk,
-        preprocess=lambda v: v.astype(jnp.float32) / 255.0,
-        # predict_float argmax on device (torch_distributed.py:112-120)
-        postprocess=lambda y: jnp.argmax(y, -1).astype(jnp.int32),
-    )
-    x = rng.integers(0, 256, (chunk * 4, 224, 224, 3), dtype=np.uint8)
-    predictor.predict(x[:chunk])  # compile
-    n_chips = len(jax.devices())
-
-    xd = jax.device_put(x)  # device-resident: measures the chip
-    _materialize(xd)
-    rates = []
-    for _ in range(3):  # best-of-3: the dev tunnel's latency is noisy
-        t0 = time.perf_counter()
-        out = predictor.predict(xd)
-        assert out.shape[0] == x.shape[0]
-        rates.append(x.shape[0] / (time.perf_counter() - t0))
-    per_chip = max(rates) / n_chips
-
-    # End-to-end streaming leg over a real Parquet file (disk ->
-    # decode -> wire -> compute -> drain).
     n_stream = chunk * 8
     with tempfile.TemporaryDirectory() as d:
-        path = os.path.join(d, "bench_stream.parquet")
-        write_rows_parquet(
-            path,
-            (rng.integers(0, 256, (chunk, 224, 224, 3), dtype=np.uint8)
-             for _ in range(n_stream // chunk)),
-            rows_per_group=chunk,
-        )
-        stats = stream_parquet_predict(
-            predictor, path, row_shape=(224, 224, 3), dtype=np.uint8,
-            batch_rows=4 * chunk,
-        )
+        with tele.span("bench/data") as _sp_data:
+            x = rng.integers(0, 256, (chunk * 4, 224, 224, 3),
+                             dtype=np.uint8)
+            path = os.path.join(d, "bench_stream.parquet")
+            write_rows_parquet(
+                path,
+                (rng.integers(0, 256, (chunk, 224, 224, 3), dtype=np.uint8)
+                 for _ in range(n_stream // chunk)),
+                rows_per_group=chunk,
+            )
+        with tele.span("bench/init") as _sp_init:
+            variables = module.init(jax.random.key(0),
+                                    np.zeros((1, 224, 224, 3), np.float32))
+            predictor = BatchPredictor(
+                module, variables["params"],
+                {k: v for k, v in variables.items() if k != "params"},
+                chunk=chunk,
+                preprocess=lambda v: v.astype(jnp.float32) / 255.0,
+                # predict_float argmax on device
+                # (torch_distributed.py:112-120)
+                postprocess=lambda y: jnp.argmax(y, -1).astype(jnp.int32),
+            )
+            _sp_init.sync(variables["params"])
+        with tele.span("bench/compile_warmup") as _sp_warm:
+            _materialize(predictor.predict(x[:chunk]))  # compile
+            _sp_warm.synced = True
+        n_chips = len(jax.devices())
+
+        with tele.span("bench/measure") as _sp_measure:
+            xd = jax.device_put(x)  # device-resident: measures the chip
+            _materialize(xd)
+            rates = []
+            for _ in range(3):  # best-of-3: the dev tunnel is noisy
+                t0 = time.perf_counter()
+                out = predictor.predict(xd)
+                assert out.shape[0] == x.shape[0]
+                rates.append(x.shape[0] / (time.perf_counter() - t0))
+            per_chip = max(rates) / n_chips
+
+            # End-to-end streaming leg over a real Parquet file (disk
+            # -> decode -> wire -> compute -> drain).
+            stats = stream_parquet_predict(
+                predictor, path, row_shape=(224, 224, 3), dtype=np.uint8,
+                batch_rows=4 * chunk,
+            )
+            _sp_measure.synced = True  # predict() drains per batch
 
     out = {
         "config": "resnet50_inference", "unit": "examples/sec/chip",
         "examples_per_sec_per_chip": round(per_chip, 1),
+        "phase_s": {
+            "data": round(_sp_data.duration_s, 3),
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+        },
         "chip_rate_rows_per_sec_per_chip": round(per_chip, 1),
         "stream_rows_per_sec": stats["rows_per_sec"],
         "stream_n_rows": stats["n_rows"],
@@ -780,6 +907,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "mnist_cnn_sync": bench_mnist_cnn_sync,
     "lazy_cnn_sync": bench_lazy_cnn_sync,
     "resnet18_hogwild": bench_resnet18_hogwild,
+    "hogwild_wire": bench_hogwild_wire,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,

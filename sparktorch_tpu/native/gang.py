@@ -34,6 +34,140 @@ class GangFailure(RuntimeError):
     pass
 
 
+class GangMetricsExporter:
+    """Tiny HTTP surface beside the gang coordinator (ROADMAP:
+    "multi-host sync training has no HTTP surface yet").
+
+    The param server already scrapes; this gives the SYNC/gang path
+    its twin: ``GET /metrics`` serves the attached telemetry snapshot
+    as Prometheus text with the heartbeat table folded in as per-rank
+    gauges (liveness, step, last-seen age, step skew — derived at
+    scrape time from the shared heartbeat directory, so a dead rank
+    shows up as a growing age even though it stopped publishing), plus
+    coordinator state (registered/failed/dead_rank) when a
+    :class:`GangCoordinator` is attached. ``GET /telemetry`` is the
+    same merged view as JSON; ``GET /heartbeats`` just the per-rank
+    table. Runs on a daemon thread like :class:`ParamServerHttp`; all
+    three pieces (telemetry, heartbeat dir, coordinator) are optional,
+    so the exporter serves whatever the deployment actually has.
+    """
+
+    def __init__(self, heartbeat_dir: Optional[str] = None,
+                 coordinator: Optional["GangCoordinator"] = None,
+                 telemetry=None, host: str = "127.0.0.1", port: int = 0):
+        self.heartbeat_dir = heartbeat_dir or os.environ.get(HEARTBEAT_DIR_ENV)
+        self.coordinator = coordinator
+        self.telemetry = telemetry
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _merged_snapshot(self) -> dict:
+        from sparktorch_tpu.obs import Telemetry, gang_report
+
+        tele = self.telemetry
+        snap = (tele.snapshot() if tele is not None
+                else Telemetry(run_id="gang_exporter").snapshot())
+        gauges = snap.setdefault("gauges", {})
+        if self.heartbeat_dir:
+            report = gang_report(self.heartbeat_dir)
+            snap["gang_report"] = report
+            for rank, rec in report.get("ranks", {}).items():
+                gauges[f"gang.hb_alive{{rank={rank}}}"] = (
+                    1.0 if rec["alive"] else 0.0
+                )
+                gauges[f"gang.hb_last_seen_age_s{{rank={rank}}}"] = (
+                    rec["last_seen_age_s"]
+                )
+                if rec.get("step") is not None:
+                    gauges[f"gang.hb_step{{rank={rank}}}"] = float(rec["step"])
+            if "step_skew" in report:
+                gauges["gang.hb_step_skew"] = float(report["step_skew"])
+            gauges["gang.hb_ranks"] = float(report.get("n_ranks", 0))
+        coord = self.coordinator
+        if coord is not None:
+            gauges["gang.coordinator_registered"] = float(coord.registered)
+            gauges["gang.coordinator_failed"] = 1.0 if coord.failed else 0.0
+            gauges["gang.coordinator_dead_rank"] = float(coord.dead_rank)
+            gauges["gang.coordinator_world_size"] = float(coord.world_size)
+        return snap
+
+    def start(self) -> "GangMetricsExporter":
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from sparktorch_tpu.obs import (
+            PROMETHEUS_CONTENT_TYPE,
+            render_prometheus,
+        )
+
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: bytes = b"",
+                      content_type: Optional[str] = None):
+                self.send_response(code)
+                if content_type:
+                    self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                route = self.path.split("?", 1)[0]
+                if route == "/":
+                    self._send(200, b"sparktorch-tpu gang exporter")
+                elif route == "/metrics":
+                    snap = exporter._merged_snapshot()
+                    snap.pop("gang_report", None)  # gauges carry it
+                    self._send(200, render_prometheus(snap).encode(),
+                               content_type=PROMETHEUS_CONTENT_TYPE)
+                elif route == "/telemetry":
+                    self._send(200,
+                               _json.dumps(
+                                   exporter._merged_snapshot()).encode(),
+                               content_type="application/json")
+                elif route == "/heartbeats":
+                    from sparktorch_tpu.obs import gang_report
+
+                    report = (gang_report(exporter.heartbeat_dir)
+                              if exporter.heartbeat_dir else {"n_ranks": 0,
+                                                              "ranks": {},
+                                                              "alive": []})
+                    self._send(200, _json.dumps(report).encode(),
+                               content_type="application/json")
+                else:
+                    self._send(404)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self):
+        return self.start() if self._httpd is None else self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
 def _lib():
     lib = load_library("gang")
     lib.gang_server_start.restype = ctypes.c_void_p

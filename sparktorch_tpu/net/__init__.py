@@ -1,0 +1,38 @@
+"""sparktorch_tpu.net — the binary wire subsystem.
+
+A framed zero-copy tensor protocol (:mod:`~sparktorch_tpu.net.wire`)
+and a persistent keep-alive client (:class:`BinaryTransport`) that
+replace dill on the hogwild parameter-server hot path. See the
+README's "Networking" section for the frame layout and semantics.
+"""
+
+from sparktorch_tpu.net.wire import (
+    CONTENT_TYPE as WIRE_CONTENT_TYPE,
+    QuantLeaf,
+    WireError,
+    decode,
+    encode,
+    flatten_tree,
+    frame_bytes,
+    frame_nbytes,
+    quantize_tree,
+    tree_nbytes,
+    unflatten_tree,
+)
+from sparktorch_tpu.net.transport import BinaryTransport, TransportError
+
+__all__ = [
+    "WIRE_CONTENT_TYPE",
+    "QuantLeaf",
+    "WireError",
+    "decode",
+    "encode",
+    "flatten_tree",
+    "frame_bytes",
+    "frame_nbytes",
+    "quantize_tree",
+    "tree_nbytes",
+    "unflatten_tree",
+    "BinaryTransport",
+    "TransportError",
+]

@@ -1,0 +1,245 @@
+"""Persistent binary-wire client for the hogwild parameter server.
+
+The reference's client (``hogwild.py:31-62``) opens a FRESH TCP
+connection per call and ships dill both ways — on the hot loop that
+is a connect + slow-start + pickle round-trip per iteration.
+:class:`BinaryTransport` replaces all three:
+
+- **keep-alive**: one ``http.client.HTTPConnection`` per worker,
+  reused across pulls/pushes (the server speaks HTTP/1.1); a dropped
+  connection is redialed with exponential backoff.
+- **binary frames** (:mod:`sparktorch_tpu.net.wire`): pushes scatter-
+  write the gradient arrays' own memory onto the socket (no pickle,
+  no join); pulls decode ``np.frombuffer`` views of the body.
+- **version-tagged pulls**: ``X-Have-Version`` + the server's 304
+  reply mean an up-to-date worker's pull is a header exchange, never
+  a parameter transfer.
+- **quantized pushes** with client-side error feedback: ``bf16``
+  (default — gradients tolerate the 8-bit mantissa, bytes halve) or
+  ``int8`` (4x, DGC-style residual feedback keeps the trajectory
+  unbiased).
+
+The interface matches ``train.hogwild``'s transport contract
+(``pull`` / ``push`` / ``post_loss`` / ``alive`` / ``stats``), so
+worker loops can't tell the wires apart — only the clock can.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from sparktorch_tpu.net import wire
+
+_TIMEOUT = 10.0        # hogwild.py:34-38 parity for push/poll
+_PULL_TIMEOUT = 180.0  # full-snapshot pulls get the generous deadline
+                       # (see train/hogwild.py:_HTTP_PULL_TIMEOUT)
+
+
+def _new_phase_stats() -> dict:
+    """Same accounting dict as ``train.hogwild._new_phase_stats`` —
+    duplicated here (not imported) so net/ never imports train/."""
+    return {
+        "pull_s": 0.0, "pull_bytes": 0, "pulls": 0, "pull_fresh": 0,
+        "push_wire_s": 0.0, "push_materialize_s": 0.0,
+        "push_bytes": 0, "pushes": 0,
+        "poll_s": 0.0,
+    }
+
+
+class TransportError(RuntimeError):
+    """The server answered with an unexpected status, or stayed
+    unreachable through every retry."""
+
+
+class BinaryTransport:
+    """Zero-copy binary client for one hogwild worker.
+
+    Not thread-safe by design: each worker owns its transport (and
+    therefore its connection and its error-feedback residuals), like
+    the dill ``HttpTransport`` before it.
+    """
+
+    def __init__(self, url: str, quant: Optional[str] = "bf16",
+                 error_feedback: bool = True,
+                 timeout: float = _TIMEOUT,
+                 pull_timeout: float = _PULL_TIMEOUT,
+                 retries: int = 3, backoff_s: float = 0.05):
+        parts = urlsplit(url if "//" in url else f"http://{url}")
+        if parts.scheme not in ("", "http"):
+            raise ValueError(f"BinaryTransport speaks http only, got {url!r}")
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or 80
+        if quant not in (None, "bf16", "int8"):
+            raise ValueError(f"quant {quant!r}; use None, 'bf16' or 'int8'")
+        self.quant = quant
+        # Error-feedback residuals, path -> np.ndarray. bf16's residual
+        # is small but free to track; int8 genuinely needs it.
+        self._residuals: Optional[Dict[Tuple[str, ...], np.ndarray]] = (
+            {} if (error_feedback and quant is not None) else None
+        )
+        self.timeout = timeout
+        self.pull_timeout = pull_timeout
+        self.retries = max(1, retries)
+        self.backoff_s = backoff_s
+        self.stats = _new_phase_stats()
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    # -- connection management --------------------------------------------
+
+    def _connection(self, timeout: float) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=timeout
+            )
+        else:
+            # Reuse the kept-alive socket; only the deadline changes.
+            self._conn.timeout = timeout
+            if self._conn.sock is not None:
+                self._conn.sock.settimeout(timeout)
+        return self._conn
+
+    def _drop_connection(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def close(self) -> None:
+        self._drop_connection()
+
+    def _request(self, method: str, path: str, body=None,
+                 headers: Optional[Dict[str, str]] = None,
+                 timeout: float = _TIMEOUT,
+                 retry_on_timeout: bool = False) -> Tuple[int, bytes]:
+        """One request over the persistent connection, with reconnect +
+        exponential backoff on connection-level failures.
+
+        Timeouts retry only when the caller marks the request
+        IDEMPOTENT (pulls/polls): a timed-out POST may have completed
+        server-side, and re-sending would double-apply a gradient.
+        A connection REFUSED/RESET before the response, by contrast,
+        is always safe to retry — including the keep-alive race where
+        the server closed an idle socket as we wrote to it.
+        """
+        retriable: tuple = (ConnectionError, http.client.HTTPException,
+                            OSError)
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            conn = self._connection(timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()  # drain so the connection is reusable
+                return resp.status, data
+            except TimeoutError as e:
+                self._drop_connection()
+                last = e
+                if not retry_on_timeout:
+                    raise
+            except retriable as e:
+                self._drop_connection()
+                last = e
+            if attempt + 1 < self.retries:
+                time.sleep(self.backoff_s * (2 ** attempt))
+        raise TransportError(
+            f"{method} {path} failed after {self.retries} attempts"
+        ) from last
+
+    # -- hogwild transport contract ---------------------------------------
+
+    def pull(self, have_version: int):
+        """``(version, params)`` newer than ``have_version``, or None
+        when the server's snapshot is not newer (its 304 reply — the
+        ETag-style exchange that costs ~100 header bytes, not a model)."""
+        st = self.stats
+        t0 = time.perf_counter()
+        status, body = self._request(
+            "GET", "/parameters.bin",
+            headers={"X-Have-Version": str(int(have_version))},
+            timeout=self.pull_timeout, retry_on_timeout=True,
+        )
+        st["pull_s"] += time.perf_counter() - t0
+        st["pulls"] += 1
+        if status == 304:
+            return None
+        if status != 200:
+            raise TransportError(f"/parameters.bin -> {status}")
+        st["pull_fresh"] += 1
+        st["pull_bytes"] += len(body)
+        version, tree = wire.decode(body)
+        return version, tree
+
+    def push(self, grads) -> None:
+        """Encode (optionally quantize with error feedback) and POST
+        the gradient tree. The materialize fence is timed apart from
+        the wire, matching the dill transport's honest accounting."""
+        st = self.stats
+        t0 = time.perf_counter()
+        # np.asarray FENCES the device: the gradient compute drains
+        # here, so this term is compute+download, and the request
+        # below is pure wire + server apply.
+        host = _tree_to_host(grads)
+        if self.quant is not None:
+            leaves, _ = wire.quantize_tree(host, self.quant, self._residuals)
+        else:
+            leaves = wire.flatten_tree(host)
+        buffers = wire.encode(leaves)
+        nbytes = wire.frame_nbytes(buffers)
+        t1 = time.perf_counter()
+        st["push_materialize_s"] += t1 - t0
+        # The buffer LIST (not an iterator): http.client scatter-sends
+        # each part, and a connection-level retry can re-iterate it —
+        # an exhausted iterator would under-send the declared length.
+        status, _ = self._request(
+            "POST", "/update.bin", body=buffers,
+            headers={"Content-Length": str(nbytes),
+                     "Content-Type": wire.CONTENT_TYPE},
+            timeout=self.timeout,
+        )
+        if status != 200:
+            raise TransportError(f"/update.bin -> {status}")
+        st["push_wire_s"] += time.perf_counter() - t1
+        st["push_bytes"] += nbytes
+        st["pushes"] += 1
+
+    def post_loss(self, loss: float) -> bool:
+        """Early-stop vote; JSON (the one non-tensor exchange — tiny,
+        and keeping it readable beats keeping it binary)."""
+        t0 = time.perf_counter()
+        payload = json.dumps({"loss": float(loss)}).encode()
+        status, body = self._request(
+            "POST", "/losses.json", body=payload,
+            headers={"Content-Type": "application/json"},
+            timeout=self.timeout,
+        )
+        if status != 200:
+            raise TransportError(f"/losses.json -> {status}")
+        self.stats["poll_s"] += time.perf_counter() - t0
+        return bool(json.loads(body)["stop"])
+
+    def alive(self) -> bool:
+        status, _ = self._request("GET", "/", timeout=self.timeout,
+                                  retry_on_timeout=True)
+        return status == 200
+
+
+def _tree_to_host(tree: Any):
+    """Materialize device arrays to host numpy, preserving structure.
+    Kept jax-optional: plain numpy trees pass through without
+    importing jax (bench_wire runs device-free)."""
+    try:
+        import jax
+
+        return jax.tree.map(lambda a: np.asarray(a), tree)
+    except ImportError:  # pragma: no cover - jax always present in-repo
+        if isinstance(tree, dict):
+            return {k: _tree_to_host(v) for k, v in tree.items()}
+        return np.asarray(tree)

@@ -87,6 +87,11 @@ def render_prometheus(snapshot: Dict[str, Any],
     for flat, value in snapshot.get("gauges", {}).items():
         name, labels = _parse_flat_key(flat)
         emit(name, "gauge", labels, value)
+    for flat, text in snapshot.get("info", {}).items():
+        # build_info convention: the string rides as a label on a
+        # constant-1 gauge, so scrapers keep it without a text type.
+        name, labels = _parse_flat_key(flat)
+        emit(name, "gauge", _merge_labels(labels, {"value": str(text)}), 1.0)
     for section in ("histograms", "spans"):
         for flat, roll in snapshot.get(section, {}).items():
             name, labels = _parse_flat_key(flat)

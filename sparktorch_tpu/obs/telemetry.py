@@ -144,6 +144,7 @@ class Telemetry:
         self._gauges: Dict[MetricKey, float] = {}
         self._hists: Dict[MetricKey, _Hist] = {}
         self._spans: Dict[MetricKey, _Hist] = {}
+        self._info: Dict[MetricKey, str] = {}
         self._sinks: List[Callable[[Dict[str, Any]], None]] = []
         self._tls = threading.local()
 
@@ -166,6 +167,21 @@ class Telemetry:
         last-seen timestamp)."""
         with self._lock:
             self._gauges[_key(name, labels)] = float(value)
+
+    def info(self, name: str, value: str,
+             labels: Optional[Dict[str, Any]] = None) -> None:
+        """Last-write-wins STRING annotation (a trace-viewer URL, a
+        build id) — the non-numeric sibling of a gauge. Snapshots carry
+        these under ``info``, so they ride the ``/telemetry`` JSON;
+        the Prometheus renderer emits them build_info-style (value 1
+        with the string as a label)."""
+        with self._lock:
+            self._info[_key(name, labels)] = str(value)
+
+    def info_value(self, name: str,
+                   labels: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        with self._lock:
+            return self._info.get(_key(name, labels))
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, Any]] = None) -> None:
@@ -282,6 +298,8 @@ class Telemetry:
                                for k, h in sorted(self._hists.items())},
                 "spans": {format_key(k): h.rollup()
                           for k, h in sorted(self._spans.items())},
+                "info": {format_key(k): v
+                         for k, v in sorted(self._info.items())},
             }
 
     def dump(self, path: str, append: bool = True) -> Dict[str, Any]:
@@ -299,6 +317,7 @@ class Telemetry:
             self._gauges.clear()
             self._hists.clear()
             self._spans.clear()
+            self._info.clear()
 
     # -- pickling ----------------------------------------------------------
     # A bus rides inside objects that get dill-dumped (a fitted model
@@ -317,10 +336,12 @@ class Telemetry:
                 "_gauges": dict(self._gauges),
                 "_hists": dict(self._hists),
                 "_spans": dict(self._spans),
+                "_info": dict(self._info),
             }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        self.__dict__.setdefault("_info", {})  # pre-info pickles
         self._lock = threading.Lock()
         self._sinks = []
         self._tls = threading.local()

@@ -42,6 +42,7 @@ import dill
 import jax
 import numpy as np
 
+from sparktorch_tpu.net import wire as binwire
 from sparktorch_tpu.obs import (
     PROMETHEUS_CONTENT_TYPE,
     Telemetry,
@@ -274,6 +275,17 @@ class ParamServerHttp:
     ``POST /update`` (dill grads), ``POST /losses`` (dill float ->
     dill {'stop': bool}).
 
+    Binary-wire routes (:mod:`sparktorch_tpu.net.wire`) render from
+    the SAME version-keyed snapshot as the dill ones, so a mixed gang
+    (dill workers next to binary workers) trains against one coherent
+    server: ``GET /parameters.bin`` (framed tensors, ``X-Have-Version``
+    honored with a real 304), ``POST /update.bin`` (framed gradient
+    tree, quantized tensors dequantized at decode), and
+    ``POST /losses.json`` (JSON early-stop vote). The server speaks
+    HTTP/1.1 so binary clients keep one connection alive for the whole
+    run. Every wire route feeds ``wire_bytes_total{route,dir}`` and a
+    per-route latency histogram into the telemetry bus.
+
     Observability routes beyond the reference: ``GET /metrics`` serves
     the server's telemetry as Prometheus exposition text (scrapeable),
     and ``GET /telemetry`` the same snapshot as JSON — both rendered
@@ -291,32 +303,58 @@ class ParamServerHttp:
 
     def start(self):
         ps = self.server
-        # Version-keyed cache of the dill-serialized host snapshot:
-        # materializing device params costs a full host download (on a
-        # tunnel-attached chip, seconds per pull) — pay it once per
-        # VERSION, not once per worker pull. The slot's version tag
-        # makes staleness detection free.
-        wire_cache: dict = {"version": None, "body": None}
+        # Version-keyed cache of the host snapshot and its rendered
+        # wire bodies: materializing device params costs a full host
+        # download (on a tunnel-attached chip, seconds per pull) — pay
+        # it once per VERSION, not once per worker pull; each wire
+        # format (dill / binary frame) then renders lazily from the
+        # one host tree, so a mixed gang shares a single download.
+        # The slot's version tag makes staleness detection free.
+        wire_cache: dict = {"version": None, "host": None,
+                            "dill": None, "bin": None}
         wire_lock = threading.Lock()
 
-        def _cached_body():
+        def _cached_body(fmt: str):
             """(version, body) from ONE slot read — the handler's
             freshness decision and the served bytes share a source of
-            truth. Serialization happens UNDER the lock: when a new
-            version lands and every worker pulls at once, late
-            arrivals block briefly and reuse the one body instead of
-            each paying the multi-second host download (and a slow
-            dump can never overwrite a newer cached entry)."""
+            truth. Materialization and rendering happen UNDER the
+            lock: when a new version lands and every worker pulls at
+            once, late arrivals block briefly and reuse the one body
+            instead of each paying the multi-second host download (and
+            a slow dump can never overwrite a newer cached entry)."""
             with wire_lock:
                 version, params = ps.slot.read()
                 if wire_cache["version"] != version:
-                    wire_cache["body"] = dill.dumps(
-                        (version, _to_host(params))
-                    )
-                    wire_cache["version"] = version
-                return version, wire_cache["body"]
+                    wire_cache.update(version=version,
+                                      host=_to_host(params),
+                                      dill=None, bin=None)
+                if wire_cache[fmt] is None:
+                    if fmt == "dill":
+                        wire_cache["dill"] = dill.dumps(
+                            (version, wire_cache["host"])
+                        )
+                    else:
+                        wire_cache["bin"] = binwire.frame_bytes(
+                            binwire.encode(wire_cache["host"],
+                                           version=version)
+                        )
+                return version, wire_cache[fmt]
+
+        def _record_wire(route: str, direction: str, nbytes: int,
+                         seconds: float) -> None:
+            """Per-route byte/latency accounting on the bus: the
+            `/metrics` series the ISSUE names (wire_bytes_total plus a
+            push/pull latency histogram per route)."""
+            ps.telemetry.counter("param_server.wire_bytes_total", nbytes,
+                                 labels={"route": route, "dir": direction})
+            ps.telemetry.observe("param_server.wire_latency_s", seconds,
+                                 labels={"route": route})
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive: binary transports hold ONE connection for a
+            # whole training run instead of a TCP setup per call.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet, like werkzeug->ERROR
                 pass  # (server.py:28-30 parity)
 
@@ -334,15 +372,27 @@ class ParamServerHttp:
                 route = self.path.split("?", 1)[0]
                 ps.telemetry.counter("param_server.http_requests",
                                      labels={"route": route})
-                if self.path == "/":
+                if route == "/":
                     self._send(200, b"sparktorch-tpu parameter server")
-                elif self.path.startswith("/parameters"):
+                elif route in ("/parameters", "/parameters.bin"):
+                    t0 = time.perf_counter()
                     have = int(self.headers.get("X-Have-Version", "-1"))
-                    version, body = _cached_body()
+                    binary = route.endswith(".bin")
+                    version, body = _cached_body("bin" if binary
+                                                 else "dill")
                     if version <= have:
-                        self._send(204)
+                        # 304 on the binary wire (true HTTP semantics);
+                        # the dill route keeps its original 204 so old
+                        # clients stay byte-compatible.
+                        self._send(304 if binary else 204)
+                        _record_wire(route, "tx", 0,
+                                     time.perf_counter() - t0)
                     else:
-                        self._send(200, body)
+                        self._send(200, body,
+                                   content_type=binwire.CONTENT_TYPE
+                                   if binary else None)
+                        _record_wire(route, "tx", len(body),
+                                     time.perf_counter() - t0)
                 elif route == "/metrics":
                     text = render_prometheus(ps.telemetry.snapshot())
                     self._send(200, text.encode(),
@@ -363,15 +413,45 @@ class ParamServerHttp:
                                      labels={"route": route})
                 length = int(self.headers.get("Content-Length", "0"))
                 raw = self.rfile.read(length)
-                if self.path == "/update":
+                if route == "/update":
+                    t0 = time.perf_counter()
                     try:
                         ps.push_gradients(dill.loads(raw))
                         self._send(200, b"OK")
+                        _record_wire(route, "rx", len(raw),
+                                     time.perf_counter() - t0)
                     except Exception:
                         self._send(500)
-                elif self.path == "/losses":
+                elif route == "/update.bin":
+                    t0 = time.perf_counter()
+                    try:
+                        _version, grads = binwire.decode(raw)
+                    except binwire.WireError:
+                        # A malformed frame is the CLIENT's bug (or a
+                        # truncated send): 400, and never counted
+                        # against the server's tolerated apply errors.
+                        self._send(400)
+                        return
+                    try:
+                        ps.push_gradients(grads)
+                        self._send(200, b"OK")
+                        _record_wire(route, "rx", len(raw),
+                                     time.perf_counter() - t0)
+                    except Exception:
+                        self._send(500)
+                elif route == "/losses":
                     stop = ps.post_loss(dill.loads(raw))
                     self._send(200, dill.dumps({"stop": bool(stop)}))
+                elif route == "/losses.json":
+                    try:
+                        loss = float(json.loads(raw)["loss"])
+                    except (ValueError, KeyError, TypeError):
+                        self._send(400)
+                        return
+                    stop = ps.post_loss(loss)
+                    self._send(200,
+                               json.dumps({"stop": bool(stop)}).encode(),
+                               content_type="application/json")
                 else:
                     self._send(404)
 

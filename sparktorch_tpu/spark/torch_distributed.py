@@ -117,6 +117,11 @@ class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
     compress = Param(Params._dummy(), "compress",
                      "hogwild: bf16-compress gradient pushes on the wire",
                      typeConverter=TypeConverters.toBoolean)
+    wire = Param(Params._dummy(), "wire",
+                 "hogwild HTTP wire format: 'binary' (framed zero-copy "
+                 "tensor protocol, keep-alive, 304 pulls) or 'dill' "
+                 "(reference-parity pickle wire for mixed-version gangs)",
+                 typeConverter=TypeConverters.toString)
 
 
 class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
@@ -136,14 +141,14 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                  partitionShuffles=None, port=None, useBarrier=None,
                  useVectorOut=None, earlyStopPatience=None, miniBatch=None,
                  validationPct=None, deployMode=None, pushEvery=None,
-                 compress=None):
+                 compress=None, wire=None):
         super().__init__()
         self._setDefault(
             predictionCol="predictions", mode="synchronous", device="tpu",
             iters=10, verbose=0, acquireLock=True, partitionShuffles=1,
             port=3000, useBarrier=True, useVectorOut=False,
             earlyStopPatience=-1, miniBatch=-1, validationPct=0.0,
-            deployMode="driver", pushEvery=1, compress=True,
+            deployMode="driver", pushEvery=1, compress=True, wire="binary",
         )
         self._set(**self._input_kwargs)
 
@@ -244,6 +249,13 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
         lock = self.getOrDefault(self.acquireLock)
         push_every = max(1, self.getOrDefault(self.pushEvery))
         compress = self.getOrDefault(self.compress)
+        wire_fmt = self.getOrDefault(self.wire)
+        if wire_fmt not in ("binary", "dill"):
+            # Fail fast like train_async(wire=...): a typo must not
+            # silently run the wrong wire in a parity experiment.
+            raise ValueError(
+                f"unknown wire {wire_fmt!r}; use 'binary' or 'dill'"
+            )
         spark = dataset.sparkSession
         driver_host = spark.conf.get("spark.driver.host", "127.0.0.1")
         n_parts = (self.getOrDefault(self.partitions)
@@ -297,7 +309,14 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                     deserialize_model as _deserialize,
                 )
 
-                transport = HttpTransport(url, compress=compress)
+                if wire_fmt == "dill":
+                    transport = HttpTransport(url, compress=compress)
+                else:
+                    from sparktorch_tpu.net.transport import BinaryTransport
+
+                    transport = BinaryTransport(
+                        url, quant="bf16" if compress else None
+                    )
                 assert transport.alive()  # GET / liveness (hogwild.py:60-62)
                 w_spec = _deserialize(torch_obj)
                 x = _rows_to_x(rows)

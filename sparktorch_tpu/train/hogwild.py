@@ -16,9 +16,13 @@ TPU-native redesign:
   iterations, ``hogwild.py:96-140`` — SURVEY flags it as a real
   behavioral quirk) is deliberately NOT reproduced: each push is the
   gradient of the current minibatch only.
-- Transports: ``local`` (in-process, device-to-device) or ``http``
-  (the reference's wire shape, stdlib client with one retry + timeout
-  like ``hogwild.py:34-38``).
+- Transports: ``local`` (in-process, device-to-device) or ``http``.
+  The HTTP wire defaults to the framed zero-copy binary protocol
+  (:mod:`sparktorch_tpu.net`): persistent keep-alive connections,
+  ``np.frombuffer`` decode, 304 not-modified pulls, quantized pushes
+  with error feedback. ``wire='dill'`` falls back to the reference's
+  wire shape (dill blobs, stdlib client with one retry + timeout like
+  ``hogwild.py:34-38``) for parity runs and mixed-version gangs.
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparktorch_tpu.net.transport import BinaryTransport
 from sparktorch_tpu.obs import get_logger, get_telemetry
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
 from sparktorch_tpu.train.step import _sown_total
@@ -155,8 +160,8 @@ class HttpTransport:
         st = self.stats
         # Materialize separately from the wire: np.asarray FENCES the
         # device (the gradient compute drains here), so this term is
-        # the honest compute+download time and the urlopen below is the
-        # pure wire+server-apply time.
+        # the honest compute+download+serialize time and the urlopen
+        # below is the pure wire+server-apply time.
         t0 = time.perf_counter()
         if self.compress:
             host_grads = jax.tree.map(
@@ -169,9 +174,12 @@ class HttpTransport:
             )
         else:
             host_grads = jax.tree.map(lambda a: np.asarray(a), grads)
+        # Serialization counts as materialize, not wire — the same
+        # bucketing as BinaryTransport (which encodes before ITS t1),
+        # so the hogwild_wire bench compares like with like.
+        payload = dill.dumps(host_grads)
         t1 = time.perf_counter()
         st["push_materialize_s"] += t1 - t0
-        payload = dill.dumps(host_grads)
         req = urllib.request.Request(
             self.url + "/update", data=payload, method="POST"
         )
@@ -463,6 +471,8 @@ def train_async(
     transport: str = "local",
     push_every: int = 1,
     compress: bool = True,
+    wire: str = "binary",
+    quant: Optional[str] = None,
     telemetry=None,
     profile_dir: Optional[str] = None,
 ) -> TrainResult:
@@ -477,6 +487,14 @@ def train_async(
     per push; pulls and the early-stop poll then happen once per
     window, so ``early_stop_patience`` counts k-iteration windows and
     staleness is bounded by one window.
+
+    ``wire`` selects the HTTP wire format: ``'binary'`` (default —
+    the framed zero-copy protocol with keep-alive connections and 304
+    not-modified pulls) or ``'dill'`` (the reference's pickle wire,
+    kept for parity and mixed-version gangs). ``quant='int8'``
+    upgrades binary pushes from bf16 to int8 with error-feedback
+    residuals; ``compress=False`` ships full-precision pushes on
+    either wire.
     """
     tele = telemetry or get_telemetry()
     spec = deserialize_model(torch_obj)
@@ -504,10 +522,22 @@ def train_async(
     try:
         if transport == "http":
             http = ParamServerHttp(server, port=port).start()
-            worker_transports = [
-                HttpTransport(http.url, compress=compress)
-                for _ in range(n_workers)
-            ]
+            if wire == "dill":
+                worker_transports = [
+                    HttpTransport(http.url, compress=compress)
+                    for _ in range(n_workers)
+                ]
+            elif wire == "binary":
+                push_quant = quant if quant else ("bf16" if compress
+                                                  else None)
+                worker_transports = [
+                    BinaryTransport(http.url, quant=push_quant)
+                    for _ in range(n_workers)
+                ]
+            else:
+                raise ValueError(
+                    f"unknown wire {wire!r}; use 'binary' or 'dill'"
+                )
             assert worker_transports[0].alive()  # liveness gate
             # (torch_distributed.py:326 parity)
         else:

@@ -21,6 +21,25 @@ import jax
 from sparktorch_tpu.obs import get_telemetry
 
 
+def trace_viewer_url(log_dir: str, host: str = "localhost",
+                     port: int = 6006) -> str:
+    """Ready-to-open TensorBoard/xprof deep link for a captured trace.
+
+    The profile plugin lists runs by the path fragment under the
+    logdir, so the URL pins the run to the trace just written; serving
+    it is one command (``tensorboard --logdir <dir>`` or
+    ``xprof --logdir <dir>``), which rides alongside on the event as
+    ``view_cmd``. A regression in a JSONL stream or a ``/telemetry``
+    scrape then links straight to its trace instead of a bare
+    directory name (ROADMAP: trace-viewer deep links)."""
+    import os
+    import urllib.parse
+
+    run = os.path.basename(os.path.normpath(log_dir)) or "."
+    return (f"http://{host}:{port}/#profile"
+            f"&run={urllib.parse.quote(run, safe='')}")
+
+
 @contextlib.contextmanager
 def profile_run(log_dir: Optional[str], telemetry=None) -> Iterator[None]:
     """Capture an XLA profiler trace for the enclosed block when
@@ -49,7 +68,13 @@ def profile_run(log_dir: Optional[str], telemetry=None) -> Iterator[None]:
         # and a filesystem path can contain both. The trace location
         # travels on the event instead.
         tele.observe("tracing.profile_s", time.perf_counter() - t0)
-        tele.event("profile_trace", log_dir=log_dir)
+        url = trace_viewer_url(log_dir)
+        # The URL ALSO lands in the snapshot's info section, so a
+        # /telemetry scrape (param server or gang exporter) links
+        # straight to the latest trace, not just the JSONL stream.
+        tele.info("tracing.trace_url", url)
+        tele.event("profile_trace", log_dir=log_dir, trace_url=url,
+                   view_cmd=f"tensorboard --logdir {log_dir}")
 
 
 def step_annotation(step: int, telemetry=None):

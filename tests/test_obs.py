@@ -523,3 +523,109 @@ def test_heartbeat_report_tolerates_torn_and_foreign_files(tmp_path):
     assert gang_report(str(tmp_path / "missing")) == {
         "n_ranks": 0, "ranks": {}, "alive": [],
     }
+
+
+# ---------------------------------------------------------------------------
+# Info strings, the gang /metrics exporter, trace-viewer deep links
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_info_in_snapshot_and_prometheus():
+    tele = Telemetry(run_id="t")
+    tele.info("tracing.trace_url", "http://localhost:6006/#profile&run=r1")
+    assert tele.info_value("tracing.trace_url").endswith("run=r1")
+    snap = tele.snapshot()
+    assert snap["info"]["tracing.trace_url"].endswith("run=r1")
+    # build_info convention: constant-1 gauge with the string label.
+    metrics = parse_prometheus(render_prometheus(snap))
+    key = ('sparktorch_tracing_trace_url'
+           '{value="http://localhost:6006/#profile&run=r1"}')
+    assert metrics[key] == 1.0
+    tele.reset()
+    assert tele.info_value("tracing.trace_url") is None
+
+
+def test_telemetry_info_survives_pickle():
+    import dill
+
+    tele = Telemetry(run_id="t")
+    tele.info("k", "v")
+    restored = dill.loads(dill.dumps(tele))
+    assert restored.info_value("k") == "v"
+
+
+def test_gang_metrics_exporter_serves_heartbeats_and_telemetry(tmp_path):
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+
+    hb_dir = str(tmp_path / "hb")
+    for rank in range(2):
+        e = HeartbeatEmitter(hb_dir, rank)
+        e.notify_step(5 + rank)
+    tele = Telemetry(run_id="gangrun")
+    tele.counter("train.steps", 7)
+    exporter = GangMetricsExporter(heartbeat_dir=hb_dir,
+                                   telemetry=tele).start()
+    try:
+        text = urllib.request.urlopen(
+            exporter.url + "/metrics", timeout=10).read().decode()
+        metrics = parse_prometheus(text)
+        # Heartbeat table folded in as per-rank gauges at scrape time.
+        assert metrics['sparktorch_gang_hb_alive{rank="0"}'] == 1.0
+        assert metrics['sparktorch_gang_hb_step{rank="1"}'] == 6.0
+        assert metrics['sparktorch_gang_hb_step_skew'] == 1.0
+        assert metrics['sparktorch_gang_hb_ranks'] == 2.0
+        # The attached bus's own series ride the same scrape.
+        assert metrics['sparktorch_train_steps'] == 7.0
+
+        t = json.loads(urllib.request.urlopen(
+            exporter.url + "/telemetry", timeout=10).read())
+        assert t["run_id"] == "gangrun"
+        assert t["gang_report"]["n_ranks"] == 2
+        assert t["gang_report"]["step_skew"] == 1
+
+        hb = json.loads(urllib.request.urlopen(
+            exporter.url + "/heartbeats", timeout=10).read())
+        assert sorted(int(r) for r in hb["ranks"]) == [0, 1]
+    finally:
+        exporter.stop()
+
+
+def test_gang_metrics_exporter_bare():
+    # No heartbeat dir, no telemetry, no coordinator: still scrapeable
+    # (empty exposition), so wiring it unconditionally is safe.
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+
+    exporter = GangMetricsExporter().start()
+    try:
+        resp = urllib.request.urlopen(exporter.url + "/metrics", timeout=10)
+        assert resp.status == 200
+    finally:
+        exporter.stop()
+
+
+def test_profile_trace_event_carries_viewer_url(tmp_path):
+    from sparktorch_tpu.obs import get_telemetry, set_telemetry
+    from sparktorch_tpu.utils.tracing import profile_run, trace_viewer_url
+
+    import jax
+    import jax.numpy as jnp
+
+    url = trace_viewer_url("/tmp/traces/run_7")
+    assert url.startswith("http://") and "#profile" in url
+    assert "run_7" in url
+
+    tele = Telemetry(run_id="t")
+    events = []
+    tele.add_sink(events.append)
+    log_dir = str(tmp_path / "trace")
+    with profile_run(log_dir, telemetry=tele):
+        float(jnp.sum(jnp.ones((8, 8))))
+    trace_events = [e for e in events if e["kind"] == "profile_trace"]
+    assert len(trace_events) == 1
+    ev = trace_events[0]
+    # The JSONL stream gets a ready-to-open URL + the serving command.
+    assert ev["trace_url"].startswith("http://") and "#profile" in ev["trace_url"]
+    assert ev["view_cmd"].startswith("tensorboard --logdir ")
+    assert ev["log_dir"] == log_dir
+    # ...and the same URL rides the snapshot (the /telemetry JSON).
+    assert tele.snapshot()["info"]["tracing.trace_url"] == ev["trace_url"]
