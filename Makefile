@@ -5,7 +5,7 @@
 PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
-	bench-wire cluster-up clean lint-obs
+	bench-wire bench-chaos cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -62,6 +62,14 @@ bench-all:
 # Non-default CI-style smoke target (no TPU or JAX device needed).
 bench-wire:
 	$(PYTHON) -m sparktorch_tpu.net.bench_wire
+
+# Fault-tolerance gate: a supervised hogwild run with ONE seeded
+# worker kill must complete with exactly one restart, a learned model,
+# and recovery overhead under budget — FAILS otherwise (the recovery
+# path is load-bearing, so its regressions should break CI, not
+# production). Runs on any backend (JAX_PLATFORMS=cpu works).
+bench-chaos:
+	$(PYTHON) -m sparktorch_tpu.bench --config hogwild_chaos
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

@@ -46,6 +46,12 @@ using Clock = std::chrono::steady_clock;
 struct GangState {
   int world_size = 0;
   int heartbeat_timeout_ms = 0;
+  // Re-registration grace window after a failure: a REG arriving
+  // within this many ms of the gang being declared failed opens a NEW
+  // GENERATION (failure cleared, membership reset, everyone must
+  // re-register) instead of being refused with DEAD. 0 = disabled
+  // (the original latch-forever behavior, still the default).
+  int rejoin_grace_ms = 0;
   std::mutex mu;
   std::condition_variable cv;
   std::map<int, std::string> members;         // rank -> addr
@@ -53,7 +59,9 @@ struct GangState {
   std::map<long, int> barrier_count;          // epoch -> arrivals
   std::atomic<bool> failed{false};
   std::atomic<int> dead_rank{-1};
+  std::atomic<long> generation{0};
   std::atomic<bool> running{true};
+  Clock::time_point failed_at;  // guarded by mu
 };
 
 struct GangServer {
@@ -101,21 +109,46 @@ void handle_conn(GangServer *srv, int fd) {
         write_all(fd, "ERR bad rank\n");
         continue;
       }
-      // A failed gang stays failed: re-registration after the member
-      // was declared dead must not resurrect the slot and mask the
-      // gang-wide DEAD verdict peers were already told about. The
-      // dialer sees DEAD, which its client treats as authoritative.
-      if (st.failed.load()) {
-        write_all(fd, "DEAD\n");
-        continue;
-      }
+      // A failed gang stays failed — UNLESS a supervisor is restarting
+      // ranks and the rejoin grace window is open: then the first
+      // re-registration after the failure opens a new generation
+      // (failure cleared, membership and barrier counts reset, every
+      // rank must re-register), so a restarted gang can reform on the
+      // same coordinator instead of being poisoned forever. Outside
+      // the window (or with grace disabled) re-registration must not
+      // resurrect the slot and mask the gang-wide DEAD verdict peers
+      // were already told about: the dialer sees DEAD, which its
+      // client treats as authoritative.
+      bool ok = false;
       {
         std::lock_guard<std::mutex> lock(st.mu);
-        st.members[rank] = addr;
-        st.last_beat[rank] = Clock::now();
+        if (st.failed.load()) {
+          auto since = std::chrono::duration_cast<std::chrono::milliseconds>(
+                           Clock::now() - st.failed_at)
+                           .count();
+          if (st.rejoin_grace_ms > 0 && since <= st.rejoin_grace_ms) {
+            st.generation.fetch_add(1);
+            st.members.clear();
+            st.last_beat.clear();
+            st.barrier_count.clear();
+            st.failed.store(false);
+            st.dead_rank.store(-1);
+            ok = true;
+          }
+        } else {
+          ok = true;
+        }
+        if (ok) {
+          st.members[rank] = addr;
+          st.last_beat[rank] = Clock::now();
+        }
       }
-      st.cv.notify_all();
-      write_all(fd, "OK " + std::to_string(st.world_size) + "\n");
+      if (ok) {
+        st.cv.notify_all();
+        write_all(fd, "OK " + std::to_string(st.world_size) + "\n");
+      } else {
+        write_all(fd, "DEAD\n");
+      }
     } else if (line.rfind("BAR ", 0) == 0) {
       long epoch = atol(line.c_str() + 4);
       std::unique_lock<std::mutex> lock(st.mu);
@@ -177,7 +210,11 @@ void monitor_loop(GangServer *srv) {
                     now - kv.second)
                     .count();
       if (ms > st.heartbeat_timeout_ms) {
-        st.failed.store(true);
+        if (!st.failed.exchange(true)) {
+          // Transition only: the grace window anchors at the FIRST
+          // failure of the episode, not at every monitor sweep.
+          st.failed_at = now;
+        }
         st.dead_rank.store(kv.first);
         st.cv.notify_all();
       }
@@ -235,10 +272,12 @@ int dial(const char *host, int port, int timeout_ms) {
 
 extern "C" {
 
-void *gang_server_start(int port, int world_size, int heartbeat_timeout_ms) {
+void *gang_server_start2(int port, int world_size, int heartbeat_timeout_ms,
+                         int rejoin_grace_ms) {
   auto *srv = new GangServer();
   srv->state.world_size = world_size;
   srv->state.heartbeat_timeout_ms = heartbeat_timeout_ms;
+  srv->state.rejoin_grace_ms = rejoin_grace_ms;
   srv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (srv->listen_fd < 0) {
     delete srv;
@@ -264,7 +303,16 @@ void *gang_server_start(int port, int world_size, int heartbeat_timeout_ms) {
   return srv;
 }
 
+void *gang_server_start(int port, int world_size, int heartbeat_timeout_ms) {
+  // Original 3-arg entry: rejoin grace disabled (latch-forever).
+  return gang_server_start2(port, world_size, heartbeat_timeout_ms, 0);
+}
+
 int gang_server_port(void *p) { return static_cast<GangServer *>(p)->port; }
+
+long gang_server_generation(void *p) {
+  return static_cast<GangServer *>(p)->state.generation.load();
+}
 
 int gang_server_failed(void *p) {
   return static_cast<GangServer *>(p)->state.failed.load() ? 1 : 0;

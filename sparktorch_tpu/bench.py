@@ -12,7 +12,8 @@ project's measured baselines. BASELINE.json configs:
 Extensions beyond the reference's scope: mnist_cnn_sync (the headline),
 long_context_lm (flash kernels at seq 8192), moe_lm (switch MoE vs its
 dense twin), hogwild_wire (dill vs framed-binary parameter-server wire
-on real sockets).
+on real sockets), hogwild_chaos (supervised recovery from one seeded
+worker kill — a gate, not just a measurement).
 
 Each bench returns a summary dict (examples/sec/chip + p50/p99 step
 times where steps exist) and appends raw per-phase records to a JSONL
@@ -561,6 +562,109 @@ def bench_hogwild_wire() -> dict:
     }
 
 
+def bench_hogwild_chaos() -> dict:
+    """Fault-tolerance gate: the SAME hogwild workload run clean and
+    under a seeded one-worker kill with supervision on. FAILS (raises)
+    unless the chaos run completes, the supervisor restarted exactly
+    one worker, the recovered model still learned, and the recovery's
+    wall-clock overhead stays under budget — so a regression in the
+    recovery path breaks `make bench-chaos`, not production.
+
+    Headline value is the measured recovery latency (death ->
+    restarted worker running, from the ``ft_recovery_latency_s``
+    histogram); ``overhead_pct`` is the chaos run's wall-clock cost
+    over the clean twin (the restarted worker reruns its round
+    assignment, so the expected overhead is roughly one worker's
+    partial rerun plus the backoff delay)."""
+    import jax
+
+    from sparktorch_tpu.ft import ChaosConfig, FtPolicy, RestartPolicy, inject
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.obs import Telemetry, get_telemetry
+    from sparktorch_tpu.train.hogwild import train_async
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    tele = get_telemetry()
+    with tele.span("bench/data") as _sp_data:
+        rng = np.random.default_rng(0)
+        n, mb = 2048, 128
+        x = rng.normal(0, 1, (n, 784)).astype(np.float32)
+        y = rng.integers(0, 10, (n,)).astype(np.int32)
+    with tele.span("bench/init") as _sp_init:
+        spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                         optimizer="adam", optimizer_params={"lr": 1e-3},
+                         input_shape=(784,))
+    iters, kill_at = 64, 16
+    # The victim must be a worker that EXISTS: train_async spawns one
+    # per device, and on a single-chip backend that is worker 0.
+    n_workers = len(jax.devices())
+    victim = 1 if n_workers > 1 else 0
+    policy = FtPolicy(restart=RestartPolicy(max_restarts=2,
+                                            backoff_base_s=0.05),
+                      seed=0)
+    with tele.span("bench/compile_warmup") as _sp_warm:
+        train_async(spec, x, labels=y, iters=8, mini_batch=mb, seed=0)
+
+    with tele.span("bench/measure") as _sp_measure:
+        t0 = time.perf_counter()
+        clean = train_async(spec, x, labels=y, iters=iters, mini_batch=mb,
+                            seed=0, supervise=True, ft_policy=policy)
+        t_clean = time.perf_counter() - t0
+
+        run_tele = Telemetry(run_id="bench_hogwild_chaos")
+        t0 = time.perf_counter()
+        with inject(ChaosConfig(kill_worker_at={victim: kill_at}, seed=0),
+                    telemetry=run_tele):
+            result = train_async(spec, x, labels=y, iters=iters,
+                                 mini_batch=mb, seed=0, supervise=True,
+                                 ft_policy=policy, telemetry=run_tele)
+        t_chaos = time.perf_counter() - t0
+
+    restarts = (result.summary or {}).get("ft", {}).get("restarts_total", -1)
+    recovery = run_tele.histogram("ft_recovery_latency_s",
+                                  labels={"worker": str(victim)})
+    overhead_pct = 100.0 * (t_chaos - t_clean) / max(t_clean, 1e-9)
+
+    # The gate. Budgets are generous (CPU rigs jitter) but real: the
+    # run must COMPLETE with exactly one restart, the model must have
+    # trained, recovery must be sub-second-scale, and the whole-run
+    # overhead bounded by a rerun of one worker plus slack.
+    if restarts != 1:
+        raise AssertionError(f"expected exactly 1 restart, got {restarts}")
+    if len(result.metrics) != len(clean.metrics):
+        raise AssertionError(
+            f"chaos run lost records: {len(result.metrics)} vs "
+            f"{len(clean.metrics)} clean"
+        )
+    if recovery["count"] < 1 or recovery["max"] > 10.0:
+        raise AssertionError(f"recovery latency out of budget: {recovery}")
+    if overhead_pct > 300.0:
+        raise AssertionError(
+            f"recovery overhead {overhead_pct:.0f}% exceeds 300% budget"
+        )
+    return {
+        "config": "hogwild_chaos", "unit": "s (recovery latency)",
+        "value": round(recovery["max"], 4),
+        "recovery_latency_s": round(recovery["max"], 4),
+        "restarts": int(restarts),
+        "wall_clean_s": round(t_clean, 3),
+        "wall_chaos_s": round(t_chaos, 3),
+        "overhead_pct": round(overhead_pct, 1),
+        "kill_at_step": kill_at,
+        "victim_worker": victim,
+        "iters": iters,
+        "n_chips": n_workers,
+        "final_loss_clean": clean.metrics[-1]["loss"],
+        "final_loss_chaos": result.metrics[-1]["loss"],
+        "phase_s": {
+            "data": round(_sp_data.duration_s, 3),
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+        },
+    }
+
+
 def _bert_flops_accounting(module, batch: int, seq: int) -> dict:
     """Honest model-FLOPs accounting for the BERT classifier.
 
@@ -908,6 +1012,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "lazy_cnn_sync": bench_lazy_cnn_sync,
     "resnet18_hogwild": bench_resnet18_hogwild,
     "hogwild_wire": bench_hogwild_wire,
+    "hogwild_chaos": bench_hogwild_chaos,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,

@@ -1,0 +1,201 @@
+"""Seeded, deterministic fault injection for the recovery paths.
+
+Recovery code that is never executed is theoretical. This module puts
+named INJECTION POINTS behind the hot paths — the hogwild worker loop,
+the binary transport's request path, the parameter server's wire
+routes, the heartbeat emitter — and a :class:`ChaosInjector` that
+decides, deterministically from an explicit config (plus a seeded RNG
+for the probabilistic modes), when each point fires:
+
+- kill a worker/rank at step N (one-shot by default, so the
+  supervisor-restarted worker survives its rerun);
+- freeze a rank's heartbeats from step N (alive-but-silent — the
+  failure mode the barrier deadline exists for);
+- drop the keep-alive connection under the next transport request
+  (exercises reconnect + backoff);
+- force server 500s on the next K pushes, or truncate the next K
+  binary pull frames (exercises the client's error paths without
+  burning the server's tolerated-error budget).
+
+Install is process-global (``with chaos(config): ...``) because the
+faults must reach code deep inside worker threads without threading a
+handle through every layer; ``fire()`` is a single global read + None
+check when no injector is installed, so production paths pay nothing.
+
+This module imports nothing from the rest of the package — injection
+points in ``net/``, ``serve/``, ``obs/`` and ``train/`` can all import
+it without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Dict, List, Mapping, Optional
+
+
+class ChaosKill(RuntimeError):
+    """Raised at an injection point to kill the enclosing worker."""
+
+
+class ChaosServerError(RuntimeError):
+    """Raised server-side to force an HTTP 500 on a wire route."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """What to break, and when. All fields are explicit (worker/rank ->
+    step, or a countdown budget), so a config replays identically;
+    ``seed`` exists for future probabilistic modes and to label runs."""
+
+    seed: int = 0
+    # worker/rank -> step: raise ChaosKill at the 'worker.step' site
+    # once the worker reaches that step. One-shot per worker by
+    # default (kill_times) so the restarted worker's rerun survives.
+    kill_worker_at: Mapping[int, int] = dataclasses.field(
+        default_factory=dict)
+    kill_times: int = 1
+    # rank -> step: stop publishing heartbeat files from that step on
+    # (the process stays alive — a freeze, not a death).
+    freeze_heartbeat_at: Mapping[int, int] = dataclasses.field(
+        default_factory=dict)
+    # Drop the client's keep-alive connection under the next K
+    # transport requests (simulates the server closing the socket /
+    # a network blip mid-run).
+    drop_connections: int = 0
+    # Force a 500 on the next K gradient pushes, server-side.
+    server_error_pushes: int = 0
+    # Truncate the next K binary pull bodies server-side (client must
+    # fail with WireError, never hang or half-decode).
+    truncate_pull_frames: int = 0
+
+
+class ChaosInjector:
+    """Evaluates a :class:`ChaosConfig` at each named site.
+
+    Thread-safe: worker threads, HTTP handler threads, and heartbeat
+    threads all consult the same injector. ``events`` records every
+    fault actually fired (site + context) for tests and post-mortems.
+    """
+
+    def __init__(self, config: ChaosConfig,
+                 telemetry: Optional[Any] = None):
+        self.config = config
+        self.telemetry = telemetry
+        self.events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._kills_fired: Dict[int, int] = {}
+        self._drops_left = int(config.drop_connections)
+        self._errors_left = int(config.server_error_pushes)
+        self._truncs_left = int(config.truncate_pull_frames)
+
+    def _record(self, site: str, **ctx: Any) -> None:
+        self.events.append({"site": site, **ctx})
+        if self.telemetry is not None:
+            self.telemetry.counter("chaos_injections_total",
+                                   labels={"site": site})
+
+    def fire(self, site: str, **ctx: Any) -> Optional[Dict[str, Any]]:
+        """Evaluate one injection point. Returns an action dict for
+        sites the caller must act on (drop/truncate/skip), raises for
+        kill/error sites, or returns None (the overwhelmingly common
+        case: nothing to inject here)."""
+        cfg = self.config
+        if site == "worker.step":
+            worker = ctx.get("worker")
+            at = cfg.kill_worker_at.get(worker)
+            if at is not None and ctx.get("step", -1) >= at:
+                with self._lock:
+                    fired = self._kills_fired.get(worker, 0)
+                    if fired >= cfg.kill_times:
+                        return None
+                    self._kills_fired[worker] = fired + 1
+                    self._record(site, **ctx)
+                raise ChaosKill(
+                    f"chaos: killed worker {worker} at step {ctx.get('step')}"
+                )
+        elif site == "heartbeat.beat":
+            rank = ctx.get("rank")
+            at = cfg.freeze_heartbeat_at.get(rank)
+            if at is not None:
+                step = ctx.get("step")
+                # at <= 0 freezes from the first beat; otherwise only
+                # once the rank has reported reaching that step.
+                if at <= 0 or (step is not None and step >= at):
+                    with self._lock:
+                        self._record(site, rank=rank, step=step)
+                    return {"skip": True}
+        elif site == "transport.request":
+            with self._lock:
+                if self._drops_left > 0:
+                    self._drops_left -= 1
+                    self._record(site, **ctx)
+                    return {"drop": True}
+        elif site == "param_server.update":
+            forced = False
+            with self._lock:
+                if self._errors_left > 0:
+                    self._errors_left -= 1
+                    self._record(site, **ctx)
+                    forced = True
+            if forced:
+                raise ChaosServerError("chaos: forced server error")
+        elif site == "param_server.pull":
+            with self._lock:
+                if self._truncs_left > 0:
+                    self._truncs_left -= 1
+                    self._record(site, **ctx)
+                    return {"truncate": True}
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Process-global installation
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[ChaosInjector] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def install(injector: ChaosInjector) -> ChaosInjector:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[ChaosInjector]:
+    return _ACTIVE
+
+
+def fire(site: str, **ctx: Any) -> Optional[Dict[str, Any]]:
+    """The call every injection point makes. Free when chaos is off."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    return inj.fire(site, **ctx)
+
+
+@contextlib.contextmanager
+def inject(config_or_injector, telemetry: Optional[Any] = None):
+    """Install an injector for a with-block; always uninstalls.
+
+    (Named ``inject``, not ``chaos``: the package re-exports this
+    beside the ``ft.chaos`` SUBMODULE, and shadowing the module name
+    would break the injection points' ``from sparktorch_tpu.ft import
+    chaos`` imports.)"""
+    inj = (config_or_injector
+           if isinstance(config_or_injector, ChaosInjector)
+           else ChaosInjector(config_or_injector, telemetry=telemetry))
+    install(inj)
+    try:
+        yield inj
+    finally:
+        uninstall()

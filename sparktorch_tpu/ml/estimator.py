@@ -212,6 +212,20 @@ class SparkTorch(Estimator):
                       "async mode: push mean of every k grads "
                       "(early-stop patience then counts windows)",
                       TypeConverters.toInt)
+    # Checkpoint/resume surface (sync mode): step-indexed orbax
+    # snapshots with auto-discovered resume — the persistence layer
+    # the reference lacks entirely (SURVEY §5).
+    checkpointDir = Param(Params._dummy(), "checkpointDir",
+                          "step-indexed checkpoint directory (sync mode)",
+                          TypeConverters.toString)
+    checkpointEvery = Param(Params._dummy(), "checkpointEvery",
+                            "save a snapshot every N steps (0 disables)",
+                            TypeConverters.toInt)
+    resume = Param(Params._dummy(), "resume",
+                   "resume from the latest FINALIZED snapshot in "
+                   "checkpointDir when one exists (auto-discovered; a "
+                   "fresh or torn directory trains from scratch)",
+                   TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self, inputCol=None, labelCol=None, predictionCol=None,
@@ -219,7 +233,8 @@ class SparkTorch(Estimator):
                  mode=None, device=None, acquireLock=None, partitionShuffles=None,
                  port=None, useBarrier=None, useVectorOut=None,
                  earlyStopPatience=None, miniBatch=None, validationPct=None,
-                 pushEvery=None, mesh=None, seed=None, n_micro=None,
+                 pushEvery=None, checkpointDir=None, checkpointEvery=None,
+                 resume=None, mesh=None, seed=None, n_micro=None,
                  pipeline_schedule=None, virtual_stages=None):
         super().__init__()
         # Defaults mirror torch_distributed.py:178-196.
@@ -238,6 +253,8 @@ class SparkTorch(Estimator):
             miniBatch=-1,
             validationPct=0.0,
             pushEvery=1,
+            checkpointEvery=0,
+            resume=False,
         )
         kwargs = dict(self._input_kwargs)
         self._mesh = kwargs.pop("mesh", None)
@@ -320,6 +337,16 @@ class SparkTorch(Estimator):
     def getValidationPct(self):
         return self.getOrDefault(self.validationPct)
 
+    def getCheckpointDir(self):
+        return (self.getOrDefault(self.checkpointDir)
+                if self.isDefined(self.checkpointDir) else None)
+
+    def getCheckpointEvery(self):
+        return self.getOrDefault(self.checkpointEvery)
+
+    def getResume(self):
+        return self.getOrDefault(self.resume)
+
     # -- fit ----------------------------------------------------------------
 
     def _extract_xy(self, df: LocalDataFrame):
@@ -346,6 +373,19 @@ class SparkTorch(Estimator):
         if mode in ("synchronous", "sync", "barrier"):
             from sparktorch_tpu.train.sync import train_distributed
 
+            # Resume only when a FINALIZED snapshot actually exists:
+            # latest_step scans the directory (skipping orbax tmp/torn
+            # saves), so resume=True over a fresh — or interrupted-
+            # before-first-save — directory trains from scratch
+            # instead of erroring, and a supervisor-restarted fit
+            # picks up exactly the snapshot the dead run finalized.
+            ckpt_dir = self.getCheckpointDir()
+            resume = False
+            if ckpt_dir and self.getResume():
+                from sparktorch_tpu.utils.checkpoint import latest_step
+
+                resume = latest_step(ckpt_dir) is not None
+
             result = train_distributed(
                 spec,
                 x,
@@ -362,6 +402,9 @@ class SparkTorch(Estimator):
                 n_micro=self._n_micro,
                 pipeline_schedule=self._pipeline_schedule,
                 virtual_stages=getattr(self, "_virtual_stages", 1),
+                checkpoint_dir=ckpt_dir,
+                checkpoint_every=self.getCheckpointEvery(),
+                resume=resume,
             )
         elif mode in ("hogwild", "async"):
             from sparktorch_tpu.train.hogwild import train_async

@@ -91,6 +91,7 @@ class GangMetricsExporter:
             gauges["gang.coordinator_failed"] = 1.0 if coord.failed else 0.0
             gauges["gang.coordinator_dead_rank"] = float(coord.dead_rank)
             gauges["gang.coordinator_world_size"] = float(coord.world_size)
+            gauges["gang.coordinator_generation"] = float(coord.generation)
         return snap
 
     def start(self) -> "GangMetricsExporter":
@@ -172,7 +173,13 @@ def _lib():
     lib = load_library("gang")
     lib.gang_server_start.restype = ctypes.c_void_p
     lib.gang_server_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.gang_server_start2.restype = ctypes.c_void_p
+    lib.gang_server_start2.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
     lib.gang_server_port.argtypes = [ctypes.c_void_p]
+    lib.gang_server_generation.restype = ctypes.c_long
+    lib.gang_server_generation.argtypes = [ctypes.c_void_p]
     lib.gang_server_failed.argtypes = [ctypes.c_void_p]
     lib.gang_server_dead_rank.argtypes = [ctypes.c_void_p]
     lib.gang_server_registered.argtypes = [ctypes.c_void_p]
@@ -194,18 +201,30 @@ def _lib():
 
 
 class GangCoordinator:
-    """Driver-side coordinator. world_size hosts must register."""
+    """Driver-side coordinator. world_size hosts must register.
+
+    ``rejoin_grace_ms`` (default 0 = disabled, the original behavior):
+    after a member is declared dead, a re-registration arriving within
+    this window opens a NEW GENERATION — the failure latch clears,
+    membership and barrier counts reset, and every rank must register
+    again — so a supervisor-restarted gang reforms on the same
+    coordinator instead of being refused with DEAD forever. Outside
+    the window, re-registration stays refused (a dead gang must not be
+    silently resurrected under survivors that already saw DEAD).
+    """
 
     def __init__(self, world_size: int, port: int = 0,
-                 heartbeat_timeout_ms: int = 10_000):
+                 heartbeat_timeout_ms: int = 10_000,
+                 rejoin_grace_ms: int = 0):
         self._lib = _lib()
-        self._handle = self._lib.gang_server_start(
-            port, world_size, heartbeat_timeout_ms
+        self._handle = self._lib.gang_server_start2(
+            port, world_size, heartbeat_timeout_ms, rejoin_grace_ms
         )
         if not self._handle:
             raise RuntimeError("gang coordinator failed to start")
         self.port = self._lib.gang_server_port(self._handle)
         self.world_size = world_size
+        self.rejoin_grace_ms = rejoin_grace_ms
 
     @property
     def failed(self) -> bool:
@@ -214,6 +233,12 @@ class GangCoordinator:
     @property
     def dead_rank(self) -> int:
         return int(self._lib.gang_server_dead_rank(self._handle))
+
+    @property
+    def generation(self) -> int:
+        """Bumped once per rejoin-after-failure episode; generation 0
+        is the original gang."""
+        return int(self._lib.gang_server_generation(self._handle))
 
     @property
     def registered(self) -> int:

@@ -34,11 +34,18 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.net import wire
 
 _TIMEOUT = 10.0        # hogwild.py:34-38 parity for push/poll
 _PULL_TIMEOUT = 180.0  # full-snapshot pulls get the generous deadline
                        # (see train/hogwild.py:_HTTP_PULL_TIMEOUT)
+# Total wall-clock cap on one request's reconnect loop. Without it, a
+# DEAD server costs retries x the per-request timeout (3 x 180s on the
+# pull path) before the worker learns anything. Must exceed ONE pull
+# timeout — the deadline is only checked between attempts, never
+# mid-request, so a healthy slow pull is never killed by it.
+_RECONNECT_DEADLINE = 240.0
 
 
 def _new_phase_stats() -> dict:
@@ -49,6 +56,7 @@ def _new_phase_stats() -> dict:
         "push_wire_s": 0.0, "push_materialize_s": 0.0,
         "push_bytes": 0, "pushes": 0,
         "poll_s": 0.0,
+        "reconnects": 0,  # redials after a connection-level failure
     }
 
 
@@ -69,7 +77,9 @@ class BinaryTransport:
                  error_feedback: bool = True,
                  timeout: float = _TIMEOUT,
                  pull_timeout: float = _PULL_TIMEOUT,
-                 retries: int = 3, backoff_s: float = 0.05):
+                 retries: int = 3, backoff_s: float = 0.05,
+                 deadline_s: Optional[float] = _RECONNECT_DEADLINE,
+                 telemetry=None):
         parts = urlsplit(url if "//" in url else f"http://{url}")
         if parts.scheme not in ("", "http"):
             raise ValueError(f"BinaryTransport speaks http only, got {url!r}")
@@ -87,8 +97,23 @@ class BinaryTransport:
         self.pull_timeout = pull_timeout
         self.retries = max(1, retries)
         self.backoff_s = backoff_s
+        # Reconnect-loop wall-clock cap: a dead server fails fast with
+        # a clear error instead of spending retries x request-timeout.
+        # None = uncapped (the pre-deadline behavior).
+        self.deadline_s = deadline_s
+        self.telemetry = telemetry
         self.stats = _new_phase_stats()
         self._conn: Optional[http.client.HTTPConnection] = None
+
+    def _count_reconnect(self) -> None:
+        self.stats["reconnects"] = self.stats.get("reconnects", 0) + 1
+        tele = self.telemetry
+        if tele is None:
+            from sparktorch_tpu.obs import get_telemetry
+
+            tele = self.telemetry = get_telemetry()
+        tele.counter("transport_reconnects_total",
+                     labels={"host": self.host, "port": self.port})
 
     # -- connection management --------------------------------------------
 
@@ -132,9 +157,24 @@ class BinaryTransport:
         retriable: tuple = (ConnectionError, http.client.HTTPException,
                             OSError)
         last: Optional[BaseException] = None
+        t_start = time.monotonic()
         for attempt in range(self.retries):
+            if (attempt > 0 and self.deadline_s is not None
+                    and time.monotonic() - t_start > self.deadline_s):
+                raise TransportError(
+                    f"{method} {path}: reconnect deadline "
+                    f"({self.deadline_s}s) exceeded after {attempt} "
+                    f"attempts — server unreachable"
+                ) from last
             conn = self._connection(timeout)
             try:
+                act = _chaos.fire("transport.request", method=method,
+                                  path=path, attempt=attempt)
+                if act and act.get("drop"):
+                    # Injected connection loss: fail THIS attempt the
+                    # way a server-closed keep-alive socket would, so
+                    # the real reconnect+backoff path runs.
+                    raise ConnectionResetError("chaos: connection dropped")
                 conn.request(method, path, body=body, headers=headers or {})
                 resp = conn.getresponse()
                 data = resp.read()  # drain so the connection is reusable
@@ -147,6 +187,7 @@ class BinaryTransport:
             except retriable as e:
                 self._drop_connection()
                 last = e
+            self._count_reconnect()
             if attempt + 1 < self.retries:
                 time.sleep(self.backoff_s * (2 ** attempt))
         raise TransportError(

@@ -26,6 +26,8 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
+from sparktorch_tpu.ft import chaos as _chaos
+
 HEARTBEAT_DIR_ENV = "SPARKTORCH_TPU_HEARTBEAT_DIR"
 _PREFIX = "gang_hb_rank"
 
@@ -55,6 +57,14 @@ class HeartbeatEmitter:
         self.beat()
 
     def beat(self, alive: bool = True) -> Dict[str, Any]:
+        # Chaos freeze: the process stays alive but stops PUBLISHING —
+        # readers see the last record's age grow, which is exactly the
+        # alive-but-wedged signature the supervisor's stall deadline
+        # exists to catch.
+        act = _chaos.fire("heartbeat.beat", rank=self.rank,
+                          step=self._step)
+        if act and act.get("skip"):
+            return {"rank": self.rank, "frozen": True}
         self._beats += 1
         record = {
             "rank": self.rank,

@@ -93,6 +93,7 @@ def bringup_multihost(
     jax_coord_port: int = DEFAULT_JAX_COORD_PORT,
     heartbeat_timeout_ms: int = 30_000,
     start_coordinator: Optional[bool] = None,
+    ft_policy=None,
 ):
     """Rendezvous the gang and initialize JAX's distributed runtime.
 
@@ -104,25 +105,56 @@ def bringup_multihost(
     Pass False when an external process (e.g. the Spark driver in the
     pyspark adapter's barrier mode) already runs one — otherwise rank
     0 would try to bind the same port a second time.
+
+    ``ft_policy`` (an :class:`sparktorch_tpu.ft.FtPolicy`) arms the
+    fault-tolerant bring-up: the coordinator opens a re-registration
+    grace window (``rejoin_grace_s`` — a supervisor-restarted rank can
+    rejoin a failed gang on a fresh generation instead of being
+    refused), and REGISTRATION retries under the policy's backoff —
+    a restarted rank dialing a coordinator that has not yet opened the
+    new generation must not give up on the first DEAD/refused reply.
     """
     if world_size <= 1:
         return None, None
 
-    from sparktorch_tpu.native.gang import GangCoordinator, GangWorker
+    from sparktorch_tpu.native.gang import (
+        GangCoordinator,
+        GangFailure,
+        GangWorker,
+    )
 
     if start_coordinator is None:
         start_coordinator = rank == 0
     coord = None
     if start_coordinator:
+        grace_ms = (int(ft_policy.rejoin_grace_s * 1000)
+                    if ft_policy is not None else 0)
         coord = GangCoordinator(world_size=world_size, port=gang_port,
-                                heartbeat_timeout_ms=heartbeat_timeout_ms)
+                                heartbeat_timeout_ms=heartbeat_timeout_ms,
+                                rejoin_grace_ms=grace_ms)
         gang_port = coord.port
         coordinator_host = coordinator_host or _local_ip()
     elif coordinator_host is None:
         coordinator_host = os.environ.get("SPARKTORCH_TPU_GANG_HOST", "127.0.0.1")
 
     my_addr = f"{_local_ip()}:{jax_coord_port}"
-    worker = GangWorker(coordinator_host, gang_port, rank, my_addr)
+    if ft_policy is None:
+        worker = GangWorker(coordinator_host, gang_port, rank, my_addr)
+    else:
+        rng = ft_policy.rng()
+        attempt = 0
+        while True:
+            try:
+                worker = GangWorker(coordinator_host, gang_port, rank,
+                                    my_addr)
+                break
+            except GangFailure:
+                if attempt >= ft_policy.restart.max_restarts:
+                    raise
+                import time as _time
+
+                _time.sleep(ft_policy.restart.delay_s(attempt, rng))
+                attempt += 1
     worker.barrier(0)  # full gang assembled
     peers = worker.world()
 

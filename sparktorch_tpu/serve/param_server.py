@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import queue
+import socket as _socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -42,6 +43,7 @@ import dill
 import jax
 import numpy as np
 
+from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.net import wire as binwire
 from sparktorch_tpu.obs import (
     PROMETHEUS_CONTENT_TYPE,
@@ -266,6 +268,44 @@ def _to_host(tree):
     return jax.tree.map(lambda a: np.asarray(a), tree)
 
 
+class _KeepAliveHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can actually STOP: with HTTP/1.1
+    keep-alive, handler threads park in a blocking read on live client
+    sockets, and ``shutdown()`` only stops the accept loop — the old
+    connections (and their threads) would survive a ``stop()`` and
+    keep serving a supposedly-dead server, which masks restarts (a
+    client's "reconnect after server restart" would silently talk to
+    the zombie). Track live request sockets and shut them down on
+    stop — the same live-fd handling the native gang coordinator does
+    in ``gang_server_stop``."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._live_requests: set = set()
+        self._live_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._live_lock:
+            self._live_requests.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._live_lock:
+            self._live_requests.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self):
+        with self._live_lock:
+            live = list(self._live_requests)
+        for sock in live:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass  # already closing
+
+
 class ParamServerHttp:
     """Expose a :class:`ParameterServer` over HTTP/1.1.
 
@@ -388,6 +428,14 @@ class ParamServerHttp:
                         _record_wire(route, "tx", 0,
                                      time.perf_counter() - t0)
                     else:
+                        act = _chaos.fire("param_server.pull",
+                                          route=route)
+                        if act and act.get("truncate"):
+                            # Injected torn response: the declared
+                            # length is honest for the bytes sent, so
+                            # the CLIENT'S frame check (WireError on a
+                            # short payload) is what must catch it.
+                            body = body[: max(1, len(body) // 2)]
                         self._send(200, body,
                                    content_type=binwire.CONTENT_TYPE
                                    if binary else None)
@@ -416,6 +464,10 @@ class ParamServerHttp:
                 if route == "/update":
                     t0 = time.perf_counter()
                     try:
+                        # Chaos 500s fire here — inside the try, so
+                        # the forced error takes the same path a real
+                        # apply failure would (a 500, nothing else).
+                        _chaos.fire("param_server.update", route=route)
                         ps.push_gradients(dill.loads(raw))
                         self._send(200, b"OK")
                         _record_wire(route, "rx", len(raw),
@@ -433,6 +485,7 @@ class ParamServerHttp:
                         self._send(400)
                         return
                     try:
+                        _chaos.fire("param_server.update", route=route)
                         ps.push_gradients(grads)
                         self._send(200, b"OK")
                         _record_wire(route, "rx", len(raw),
@@ -455,7 +508,7 @@ class ParamServerHttp:
                 else:
                     self._send(404)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = _KeepAliveHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -468,5 +521,9 @@ class ParamServerHttp:
     def stop(self):
         if self._httpd is not None:
             self._httpd.shutdown()
+            # Drop live keep-alive connections too: a stopped server
+            # must go DARK (clients redial a restarted one), not keep
+            # answering through parked handler threads.
+            self._httpd.close_all_connections()
             self._httpd.server_close()
             self._httpd = None

@@ -122,6 +122,25 @@ class _SparkTorchParams(HasInputCol, HasLabelCol, HasPredictionCol):
                  "tensor protocol, keep-alive, 304 pulls) or 'dill' "
                  "(reference-parity pickle wire for mixed-version gangs)",
                  typeConverter=TypeConverters.toString)
+    supervise = Param(Params._dummy(), "supervise",
+                      "fault tolerance: restart a failed barrier stage "
+                      "under the ft policy, resuming from the latest "
+                      "checkpoint; the gang coordinator opens a rejoin "
+                      "grace window so restarted ranks re-register on a "
+                      "fresh generation",
+                      typeConverter=TypeConverters.toBoolean)
+    ftMaxRestarts = Param(Params._dummy(), "ftMaxRestarts",
+                          "fault tolerance: restart budget for the "
+                          "supervised barrier stage",
+                          typeConverter=TypeConverters.toInt)
+    checkpointDir = Param(Params._dummy(), "checkpointDir",
+                          "step-indexed checkpoint directory (shared FS "
+                          "across TPU hosts); supervised restarts resume "
+                          "from the latest finalized snapshot",
+                          typeConverter=TypeConverters.toString)
+    checkpointEvery = Param(Params._dummy(), "checkpointEvery",
+                            "save a snapshot every N steps (0 disables)",
+                            typeConverter=TypeConverters.toInt)
 
 
 class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
@@ -141,7 +160,9 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                  partitionShuffles=None, port=None, useBarrier=None,
                  useVectorOut=None, earlyStopPatience=None, miniBatch=None,
                  validationPct=None, deployMode=None, pushEvery=None,
-                 compress=None, wire=None):
+                 compress=None, wire=None, supervise=None,
+                 ftMaxRestarts=None, checkpointDir=None,
+                 checkpointEvery=None):
         super().__init__()
         self._setDefault(
             predictionCol="predictions", mode="synchronous", device="tpu",
@@ -149,6 +170,7 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
             port=3000, useBarrier=True, useVectorOut=False,
             earlyStopPatience=-1, miniBatch=-1, validationPct=0.0,
             deployMode="driver", pushEvery=1, compress=True, wire="binary",
+            supervise=False, ftMaxRestarts=2, checkpointEvery=0,
         )
         self._set(**self._input_kwargs)
 
@@ -432,6 +454,10 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
         shuffles = self.getOrDefault(self.partitionShuffles)
         verbose = self.getOrDefault(self.verbose)
         patience = self.getOrDefault(self.earlyStopPatience)
+        supervise = self.getOrDefault(self.supervise)
+        ckpt_dir = (self.getOrDefault(self.checkpointDir)
+                    if self.isDefined(self.checkpointDir) else None)
+        ckpt_every = self.getOrDefault(self.checkpointEvery)
         spark = dataset.sparkSession
         gang_host = spark.conf.get("spark.driver.host", "127.0.0.1")
         n_hosts = (self.getOrDefault(self.partitions)
@@ -441,16 +467,33 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
         if rdd.getNumPartitions() != n_hosts:
             rdd = rdd.repartition(n_hosts)
 
+        from sparktorch_tpu.ft import FtPolicy, RestartPolicy
+
+        ft_policy = (
+            FtPolicy(restart=RestartPolicy(
+                max_restarts=self.getOrDefault(self.ftMaxRestarts)))
+            if supervise else None
+        )
+
         # The coordinator runs HERE on the driver; barrier tasks must
         # not start their own (start_coordinator=False below). Port 0 =
         # ephemeral: two concurrent fits on one driver cannot collide;
-        # the bound port travels to the tasks in the closure.
+        # the bound port travels to the tasks in the closure. Under
+        # supervision the coordinator opens a rejoin grace window so a
+        # restarted stage's ranks re-register on a fresh generation.
         from sparktorch_tpu.native.gang import GangCoordinator
 
-        coord = GangCoordinator(world_size=n_hosts, port=0)
+        coord = GangCoordinator(
+            world_size=n_hosts, port=0,
+            rejoin_grace_ms=(int(ft_policy.rejoin_grace_s * 1000)
+                             if ft_policy is not None else 0),
+        )
         gang_port = coord.port
 
-        def run_host(iterator):
+        def make_run_host(resume: bool):
+            return lambda iterator: run_host(iterator, resume)
+
+        def run_host(iterator, resume=False):
             from pyspark import BarrierTaskContext
 
             ctx = BarrierTaskContext.get()
@@ -472,12 +515,15 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
             _, worker = bringup_multihost(
                 rank=rank, world_size=n_hosts, coordinator_host=gang_host,
                 gang_port=gang_port, start_coordinator=False,
+                ft_policy=ft_policy,
             )
             try:
                 result = train_distributed_multihost(
                     torch_obj, x, local_y=y, iters=iters,
                     partition_shuffles=shuffles, verbose=verbose,
                     mini_batch=mini_batch, early_stop_patience=patience,
+                    checkpoint_dir=ckpt_dir, checkpoint_every=ckpt_every,
+                    resume=resume,
                 )
                 # The SPMD result is replicated; rank 0's copy is
                 # canonical (the reference keeps collect()[0],
@@ -491,7 +537,25 @@ class SparkTorch(Estimator, _SparkTorchParams, PythonStagePersistence,
                     worker.close()  # also unregisters the liveness check
 
         try:
-            out = rdd.barrier().mapPartitions(run_host).collect()
+            if supervise:
+                # Stage-level recovery: a dead rank fails the whole
+                # barrier stage (Spark semantics); the supervisor
+                # restarts the STAGE under the ft policy, resuming
+                # from the latest finalized checkpoint (auto-
+                # discovered), and the coordinator's rejoin grace lets
+                # the new generation of ranks re-register.
+                from sparktorch_tpu.ft import supervise_run
+
+                out = supervise_run(
+                    lambda attempt, resume: rdd.barrier().mapPartitions(
+                        make_run_host(resume)).collect(),
+                    policy=ft_policy,
+                    checkpoint_dir=ckpt_dir,
+                    name="spark_barrier_stage",
+                )
+            else:
+                out = rdd.barrier().mapPartitions(
+                    make_run_host(False)).collect()
         finally:
             coord.stop()
         if not out:

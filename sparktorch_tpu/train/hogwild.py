@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.net.transport import BinaryTransport
 from sparktorch_tpu.obs import get_logger, get_telemetry
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
@@ -367,6 +368,10 @@ def _worker_loop(
         # in the push's materialize fence)
         t_loop0 = time.perf_counter()
         while it < iters:
+            # Chaos injection point: a seeded config can kill THIS
+            # worker at step N (ChaosKill lands in `errors` like any
+            # real failure; under supervision it triggers a restart).
+            _chaos.fire("worker.step", worker=worker_id, step=it)
             snap = transport.pull(have_version)
             if snap is not None:
                 have_version, params = snap
@@ -475,6 +480,8 @@ def train_async(
     quant: Optional[str] = None,
     telemetry=None,
     profile_dir: Optional[str] = None,
+    supervise: bool = False,
+    ft_policy=None,
 ) -> TrainResult:
     """Asynchronous parameter-server training.
 
@@ -495,8 +502,22 @@ def train_async(
     upgrades binary pushes from bf16 to int8 with error-feedback
     residuals; ``compress=False`` ships full-precision pushes on
     either wire.
+
+    ``supervise=True`` (or any ``ft_policy``) runs the workers under
+    the fault-tolerance supervisor (:mod:`sparktorch_tpu.ft`): a dead
+    worker is restarted with exponential backoff + jitter under the
+    policy's per-worker budget, and REJOINS by pulling the current
+    server version on its first pull — gradients the dead attempt
+    already pushed stay applied (hogwild semantics). The restart unit
+    is the worker's round assignment (a killed attempt flushes no
+    records, so the restarted attempt reruns the round's iterations).
+    Recovery is observable as ``ft_restarts_total`` /
+    ``ft_recovery_latency_s`` on the run's telemetry bus — the same
+    bus ``/metrics`` scrapes.
     """
     tele = telemetry or get_telemetry()
+    if ft_policy is not None:
+        supervise = True
     spec = deserialize_model(torch_obj)
     with tele.span("hogwild/data_prep"):
         train_batch, val_batch = _as_batch(data, labels, validation_pct, seed)
@@ -531,7 +552,8 @@ def train_async(
                 push_quant = quant if quant else ("bf16" if compress
                                                   else None)
                 worker_transports = [
-                    BinaryTransport(http.url, quant=push_quant)
+                    BinaryTransport(http.url, quant=push_quant,
+                                    telemetry=tele)
                     for _ in range(n_workers)
                 ]
             else:
@@ -557,6 +579,7 @@ def train_async(
         records: List[dict] = []
         errors: List[BaseException] = []
         phase_stats: List[dict] = []
+        ft_summaries: List[dict] = []
         x = np.asarray(train_batch.x)
         y = np.asarray(train_batch.y)
         w = np.asarray(train_batch.w)
@@ -582,41 +605,75 @@ def train_async(
             ys = np.array_split(y, n_workers)
             ws = np.array_split(w, n_workers)
             t_round0 = time.perf_counter()
-            threads = []
+            worker_args = []
             for i in range(n_workers):
                 shard = DataBatch(
                     jnp.asarray(xs[i]), jnp.asarray(ys[i]), jnp.asarray(ws[i])
                 )
-                t = threading.Thread(
-                    target=_worker_loop,
-                    args=(
-                        i,
-                        devices[i % len(devices)],
-                        worker_transports[i],
-                        grad_step,
-                        model_state,
-                        shard,
-                        jax.device_put(val_batch, devices[i % len(devices)])
-                        if val_batch is not None
-                        else None,
-                        iters,
-                        verbose,
-                        early_stop_patience is not None and early_stop_patience > 0,
-                        seed + round_idx * n_workers,
-                        records,
-                        errors,
-                        push_every,
-                        eval_loss,
-                        grad_windows,
-                        phase_stats,
-                        tele,
-                    ),
-                    daemon=True,
+                worker_args.append((
+                    i,
+                    devices[i % len(devices)],
+                    worker_transports[i],
+                    grad_step,
+                    model_state,
+                    shard,
+                    jax.device_put(val_batch, devices[i % len(devices)])
+                    if val_batch is not None
+                    else None,
+                    iters,
+                    verbose,
+                    early_stop_patience is not None and early_stop_patience > 0,
+                    seed + round_idx * n_workers,
+                    records,
+                ))
+            if supervise:
+                # The fault-tolerant path: each worker is a supervised
+                # task. A dead worker (chaos kill, transport failure,
+                # anything the loop surfaces) restarts under the
+                # policy's backoff+budget and rejoins by pulling the
+                # current server version — a killed attempt flushed no
+                # records, so the restarted attempt reruns the round's
+                # assignment and the record count stays exact.
+                from sparktorch_tpu.ft.supervisor import (
+                    Supervisor,
+                    ThreadWorker,
                 )
-                threads.append(t)
-                t.start()
-            for t in threads:
-                t.join()
+
+                sup = Supervisor(policy=ft_policy, telemetry=tele,
+                                 name=f"hogwild_round{round_idx}")
+
+                def make_start(args):
+                    def target():
+                        # A fresh error list per attempt: the loop
+                        # traps its failure there; re-raising hands it
+                        # to the supervisor's handle as THE failure.
+                        attempt_errors: List[BaseException] = []
+                        _worker_loop(*args, attempt_errors, push_every,
+                                     eval_loss, grad_windows,
+                                     phase_stats, tele)
+                        if attempt_errors:
+                            raise attempt_errors[0]
+
+                    return lambda attempt: ThreadWorker(
+                        f"w{args[0]}", target
+                    )
+
+                for args in worker_args:
+                    sup.add(str(args[0]), make_start(args), rank=args[0])
+                ft_summaries.append(sup.run())
+            else:
+                threads = []
+                for args in worker_args:
+                    t = threading.Thread(
+                        target=_worker_loop,
+                        args=(*args, errors, push_every, eval_loss,
+                              grad_windows, phase_stats, tele),
+                        daemon=True,
+                    )
+                    threads.append(t)
+                    t.start()
+                for t in threads:
+                    t.join()
             tele.observe("hogwild.round_s", time.perf_counter() - t_round0)
             tele.counter("hogwild.rounds")
             if errors:
@@ -647,6 +704,15 @@ def train_async(
                 "hogwild_phases": phase_stats,
                 "hogwild_budget": tot,
                 "server_applied": server.applied_updates,
+            }
+        if ft_summaries:
+            summary = dict(summary or {})
+            summary["ft"] = {
+                "rounds": ft_summaries,
+                "restarts_total": sum(
+                    sum(s.get("restarts", {}).values())
+                    for s in ft_summaries
+                ),
             }
         return TrainResult(
             params=params, model_state=model_state, metrics=records,

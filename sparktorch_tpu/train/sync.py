@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.obs import get_logger, get_telemetry
 from sparktorch_tpu.parallel.launch import check_gang, notify_gang_step
 from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, build_mesh, replicated
@@ -351,9 +352,19 @@ def train_distributed(
                 # compiled chunk — means we raise GangFailure instead of
                 # wedging in the chunk's collectives. The same spot
                 # publishes this rank's progress on its heartbeat so
-                # the driver can read cross-rank step skew.
+                # the driver can read cross-rank step skew, and hosts
+                # the chaos kill point (a seeded injection dies here,
+                # between compiled dispatches — where a real preempt
+                # lands; ft.supervisor.supervise_run then restarts the
+                # attempt resuming from the latest checkpoint).
                 check_gang()
                 notify_gang_step(i)
+                # `i` (the round-local iteration), not state.step: the
+                # latter would cost a device sync per chunk on the hot
+                # path; one-shot kill configs make the distinction
+                # irrelevant across resumes.
+                _chaos.fire("worker.step", worker=jax.process_index(),
+                            step=i)
                 t0 = time.perf_counter()
                 if steps_per_call > 1:
                     n = min(steps_per_call, iters - i)

@@ -42,6 +42,43 @@ def _disarm_persistent_cache_after_restore() -> None:
         pass
 
 
+_ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp"
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest FINALIZED snapshot step in a checkpoint directory, from
+    a plain directory scan — no orbax ``CheckpointManager`` is
+    instantiated, so the supervisor's restart path (and an estimator
+    deciding whether a resume is even possible) can auto-discover
+    checkpoints cheaply and safely while another process may still be
+    writing.
+
+    A finalized step is a non-empty, all-digits directory name with no
+    orbax tmp marker anywhere in it; in-progress or interrupted saves
+    (``<step>.orbax-checkpoint-tmp-<ts>``, or a step dir still holding
+    tmp items) are skipped, never returned as resumable. Returns None
+    when the directory is missing or holds nothing finalized."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = []
+    for name in names:
+        if _ORBAX_TMP_MARKER in name or not name.isdigit():
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            entries = os.listdir(path)
+        except OSError:
+            continue
+        if not entries or any(_ORBAX_TMP_MARKER in e for e in entries):
+            continue
+        steps.append(int(name))
+    return max(steps) if steps else None
+
+
 def _is_typed_key(leaf: Any) -> bool:
     dtype = getattr(leaf, "dtype", None)
     return dtype is not None and jax.dtypes.issubdtype(

@@ -238,6 +238,48 @@ def test_gang_reg_rejected_after_failure():
         w1.close()
 
 
+def test_gang_rejoin_grace_window_new_generation():
+    # The fault-tolerance satellite: with a rejoin grace window armed
+    # (the supervisor's restart path), a re-registration after a
+    # failure opens a NEW GENERATION — failure latch cleared,
+    # membership reset, every rank re-registers — instead of the
+    # refuse-forever default pinned by
+    # test_gang_reg_rejected_after_failure above.
+    with GangCoordinator(world_size=2, heartbeat_timeout_ms=300,
+                         rejoin_grace_ms=20_000) as coord:
+        w0 = GangWorker("127.0.0.1", coord.port, 0, "a:1",
+                        heartbeat_interval_s=0.1)
+        w1 = GangWorker("127.0.0.1", coord.port, 1, "b:1",
+                        heartbeat_interval_s=0.1)
+        w1.suspend_heartbeat()
+        deadline = time.time() + 10
+        while not coord.failed and time.time() < deadline:
+            time.sleep(0.05)
+        assert coord.failed and coord.generation == 0
+        w0.close()
+        w1.close()
+
+        # The supervisor restarts the ranks; the first re-REG flips
+        # the generation and clears the failure latch.
+        r1 = GangWorker("127.0.0.1", coord.port, 1, "b:1",
+                        heartbeat_interval_s=0.1)
+        assert coord.generation == 1
+        assert not coord.failed
+        r0 = GangWorker("127.0.0.1", coord.port, 0, "a:1",
+                        heartbeat_interval_s=0.1)
+        # The reformed gang is fully functional: barrier releases,
+        # peer table is complete.
+        t = threading.Thread(target=r1.barrier, args=(0,))
+        t.start()
+        r0.barrier(0)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(r0.world()) == 2
+        assert coord.dead_rank == -1
+        r0.close()
+        r1.close()
+
+
 def test_trainer_aborts_when_peer_host_dies():
     # Trainer-level failure path: a multi-host run where a PEER host
     # dies mid-training. The survivor's training loop polls the gang
