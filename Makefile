@@ -5,23 +5,48 @@
 PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
-	bench-wire bench-chaos cluster-up clean lint-obs
+	bench-wire bench-chaos bench-chaos-soak bench-trace cluster-up \
+	clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
 
-# Library code must not print: structured telemetry goes through
-# sparktorch_tpu.obs (spans/counters/JSONL//metrics), human lines
-# through obs.log.get_logger. The reference's print-based story
-# (distributed.py:201-204, hogwild.py:133-134) must not creep back in.
-# bench.py and net/bench_wire.py are CLIs — their stdout JSON lines
-# are their contract.
+# Library code must not sidestep the obs subsystem:
+# - no raw print(): structured telemetry goes through sparktorch_tpu.obs
+#   (spans/counters/JSONL//metrics), human lines through
+#   obs.log.get_logger. The reference's print-based story
+#   (distributed.py:201-204, hogwild.py:133-134) must not creep back
+#   in. bench.py, net/bench_wire.py and obs/timeline.py are CLIs —
+#   their stdout is their contract.
+# - no bare Telemetry.span(...) calls: a span only records when its
+#   with-block closes; a bare call leaks an un-timed region onto the
+#   thread-local stack and re-paths every nested span under it.
+# - no raw json.dump of trace/telemetry events outside obs/: timeline
+#   data must flow through the sinks (atomicity, append semantics,
+#   scrape==dump parity). Genuine non-telemetry persistence writes
+#   carry a `lint-obs: ok (<why>)` annotation.
 lint-obs:
 	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
 		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:' \
-		| grep -v '^sparktorch_tpu/net/bench_wire\.py:'); \
+		| grep -v '^sparktorch_tpu/net/bench_wire\.py:' \
+		| grep -v '^sparktorch_tpu/obs/timeline\.py:'); \
 	if [ -n "$$hits" ]; then \
 		echo "lint-obs: raw print() in library code (use obs.get_logger):"; \
+		echo "$$hits"; exit 1; \
+	fi; \
+	hits=$$(grep -rn --include='*.py' -E '\.span\(' sparktorch_tpu/ \
+		| grep -v 'with ' | grep -v '^sparktorch_tpu/obs/' \
+		| grep -v 'lint-obs: ok'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: bare Telemetry.span() call (must be a with-block):"; \
+		echo "$$hits"; exit 1; \
+	fi; \
+	hits=$$(grep -rn --include='*.py' -E '(^|[^.[:alnum:]_])json\.dump\(' \
+		sparktorch_tpu/ | grep -v '^sparktorch_tpu/obs/' \
+		| grep -v 'lint-obs: ok'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: raw json.dump outside obs/ (use obs sinks, or"; \
+		echo "annotate non-telemetry persistence with 'lint-obs: ok (<why>)'):"; \
 		echo "$$hits"; exit 1; \
 	fi; echo "lint-obs OK"
 
@@ -70,6 +95,25 @@ bench-wire:
 # production). Runs on any backend (JAX_PLATFORMS=cpu works).
 bench-chaos:
 	$(PYTHON) -m sparktorch_tpu.bench --config hogwild_chaos
+
+# Chaos SOAK gate: a seeded multi-round random kill/freeze/drop
+# schedule through the supervisor — FAILS unless every round completes
+# with restart count == injected kills, stall preemptions == injected
+# freezes, and exact record counts (no double-counting). Catches
+# recovery races the single-fault bench-chaos gate cannot.
+bench-chaos-soak:
+	$(PYTHON) -m sparktorch_tpu.bench --config hogwild_chaos_soak
+
+# Trace-attribution gate: capture a sharded-step XLA profile, analyze
+# it offline (obs.xprof), and FAIL unless >=1 collective is found, the
+# step-slice wall reconciles with the bus span wall, and a real
+# /metrics scrape equals the JSONL telemetry dump for the xprof
+# metrics. Defaults to the 8-virtual-device CPU backend so it runs
+# anywhere (override JAX_PLATFORMS/XLA_FLAGS for a real accelerator).
+bench-trace:
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
+	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+	$(PYTHON) -m sparktorch_tpu.bench --config sharded_trace
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

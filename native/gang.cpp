@@ -16,10 +16,25 @@
 // Exposed as a C API for ctypes (no pybind11 in this toolchain).
 //
 // Protocol (line-based over TCP):
-//   REG <rank> <addr>\n   -> OK <world_size>\n | ERR <msg>\n
-//   BAR <epoch>\n         -> GO\n | DEAD\n
-//   WLD\n                 -> <rank0 addr>,<rank1 addr>,...\n
-//   HB <rank>\n           -> OK\n | DEAD\n
+//   REG <rank> <addr> [<gen>]\n -> OK <world_size> <gen>\n | ERR <msg>\n
+//   BAR <epoch>\n               -> GO\n | DEAD\n
+//   WLD\n                       -> <rank0 addr>,<rank1 addr>,...\n
+//   HB <rank> [<gen>]\n         -> OK\n | DEAD\n
+//
+// The optional <gen> tag (generation-tagged protocol) closes the
+// rejoin-grace race: REG/HB lines carry the generation the client
+// JOINED, and the coordinator refuses stale ones with DEAD. A fresh
+// client tags REG with -1 ("never joined"); the OK reply carries the
+// generation it joined, which the client echoes on every subsequent
+// HB and reconnect-REG. During the rejoin grace window only FRESH
+// registrations (gen -1, i.e. supervisor-restarted ranks — or
+// untagged old-version clients) open the new generation; a survivor
+// of the failed generation whose heartbeat socket broke re-REGs with
+// its old tag and is told DEAD instead of silently resurrecting the
+// gang under peers that still hold old-generation connections.
+// Untagged lines parse exactly as before, so mixed-version gangs
+// (old client/new coordinator or the reverse) keep working — an old
+// coordinator simply ignores the extra token and replies "OK <ws>".
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -103,15 +118,17 @@ void handle_conn(GangServer *srv, int fd) {
   while (st.running.load() && read_line(fd, &line)) {
     if (line.rfind("REG ", 0) == 0) {
       int rank = -1;
+      long gen = -1;  // -1 = fresh/untagged
       char addr[1024] = {0};
-      if (sscanf(line.c_str(), "REG %d %1023s", &rank, addr) != 2 ||
-          rank < 0 || rank >= st.world_size) {
+      int n_tok = sscanf(line.c_str(), "REG %d %1023s %ld", &rank, addr, &gen);
+      if (n_tok < 2 || rank < 0 || rank >= st.world_size) {
         write_all(fd, "ERR bad rank\n");
         continue;
       }
+      if (n_tok == 2) gen = -1;
       // A failed gang stays failed — UNLESS a supervisor is restarting
       // ranks and the rejoin grace window is open: then the first
-      // re-registration after the failure opens a new generation
+      // FRESH re-registration after the failure opens a new generation
       // (failure cleared, membership and barrier counts reset, every
       // rank must re-register), so a restarted gang can reform on the
       // same coordinator instead of being poisoned forever. Outside
@@ -119,15 +136,27 @@ void handle_conn(GangServer *srv, int fd) {
       // resurrect the slot and mask the gang-wide DEAD verdict peers
       // were already told about: the dialer sees DEAD, which its
       // client treats as authoritative.
+      //
+      // Generation tags narrow who may (re)join:
+      // - healthy gang: fresh (-1) or current-generation tags register;
+      //   a STALE tag (an old-generation survivor reconnecting after
+      //   a rejoin already opened a new generation) is refused DEAD.
+      // - failed gang in grace: only FRESH registrations open the new
+      //   generation; a tag equal to the failed generation is a
+      //   surviving member of the dead gang whose socket broke — it
+      //   must hear DEAD, not resurrect the gang under its peers.
       bool ok = false;
+      long cur_gen = 0;
       {
         std::lock_guard<std::mutex> lock(st.mu);
+        cur_gen = st.generation.load();
         if (st.failed.load()) {
           auto since = std::chrono::duration_cast<std::chrono::milliseconds>(
                            Clock::now() - st.failed_at)
                            .count();
-          if (st.rejoin_grace_ms > 0 && since <= st.rejoin_grace_ms) {
-            st.generation.fetch_add(1);
+          if (gen < 0 && st.rejoin_grace_ms > 0 &&
+              since <= st.rejoin_grace_ms) {
+            cur_gen = st.generation.fetch_add(1) + 1;
             st.members.clear();
             st.last_beat.clear();
             st.barrier_count.clear();
@@ -135,7 +164,7 @@ void handle_conn(GangServer *srv, int fd) {
             st.dead_rank.store(-1);
             ok = true;
           }
-        } else {
+        } else if (gen < 0 || gen == cur_gen) {
           ok = true;
         }
         if (ok) {
@@ -145,7 +174,8 @@ void handle_conn(GangServer *srv, int fd) {
       }
       if (ok) {
         st.cv.notify_all();
-        write_all(fd, "OK " + std::to_string(st.world_size) + "\n");
+        write_all(fd, "OK " + std::to_string(st.world_size) + " " +
+                          std::to_string(cur_gen) + "\n");
       } else {
         write_all(fd, "DEAD\n");
       }
@@ -167,12 +197,23 @@ void handle_conn(GangServer *srv, int fd) {
                         ? "GO\n"
                         : "DEAD\n");
     } else if (line.rfind("HB ", 0) == 0) {
-      int rank = atoi(line.c_str() + 3);
+      int rank = -1;
+      long gen = -1;
+      int n_tok = sscanf(line.c_str(), "HB %d %ld", &rank, &gen);
+      if (n_tok < 2) gen = -1;
+      // A tagged heartbeat from a PREVIOUS generation is a survivor
+      // of a gang that already reformed (or failed) under it: reply
+      // DEAD so it learns within one heartbeat interval, and do NOT
+      // refresh the slot — its beat must not keep the reformed
+      // generation's member alive. Untagged beats keep the original
+      // semantics (old clients in mixed-version gangs).
+      bool stale = false;
       {
         std::lock_guard<std::mutex> lock(st.mu);
-        st.last_beat[rank] = Clock::now();
+        stale = gen >= 0 && gen != st.generation.load();
+        if (n_tok >= 1 && !stale) st.last_beat[rank] = Clock::now();
       }
-      write_all(fd, st.failed.load() ? "DEAD\n" : "OK\n");
+      write_all(fd, (stale || st.failed.load()) ? "DEAD\n" : "OK\n");
     } else if (line == "WLD") {
       std::string out;
       {
@@ -238,6 +279,7 @@ void accept_loop(GangServer *srv) {
 struct GangClient {
   int fd = -1;
   int rank = -1;
+  long generation = -1;  // generation joined; -1 = old/untagged server
 };
 
 int dial(const char *host, int port, int timeout_ms) {
@@ -356,13 +398,17 @@ void gang_server_stop(void *p) {
 
 // status (when non-null): 0 = registered, 1 = coordinator replied DEAD
 // (the gang already failed — authoritative, do not retry), -1 = io/ERR.
-void *gang_client_connect2(const char *host, int port, int rank,
-                           const char *addr, int timeout_ms, int *status) {
+// generation: the tag sent on the REG line (-1 = fresh, never joined;
+// >=0 = rejoining member of that generation — refused once stale).
+void *gang_client_connect3(const char *host, int port, int rank,
+                           const char *addr, int timeout_ms,
+                           long generation, int *status) {
   if (status) *status = -1;
   int fd = dial(host, port, timeout_ms);
   if (fd < 0) return nullptr;
   auto *cli = new GangClient{fd, rank};
-  std::string msg = "REG " + std::to_string(rank) + " " + addr + "\n";
+  std::string msg = "REG " + std::to_string(rank) + " " + addr + " " +
+                    std::to_string(generation) + "\n";
   std::string resp;
   if (!write_all(fd, msg) || !read_line(fd, &resp) ||
       resp.rfind("OK", 0) != 0) {
@@ -371,13 +417,29 @@ void *gang_client_connect2(const char *host, int port, int rank,
     delete cli;
     return nullptr;
   }
+  // "OK <world_size> <generation>" from a tagged coordinator; an old
+  // coordinator replies "OK <world_size>" and the client stays
+  // untagged (generation -1 -> legacy HB lines).
+  long ws = 0, gen = -1;
+  if (sscanf(resp.c_str(), "OK %ld %ld", &ws, &gen) == 2) {
+    cli->generation = gen;
+  }
   if (status) *status = 0;
   return cli;
 }
 
+void *gang_client_connect2(const char *host, int port, int rank,
+                           const char *addr, int timeout_ms, int *status) {
+  return gang_client_connect3(host, port, rank, addr, timeout_ms, -1, status);
+}
+
 void *gang_client_connect(const char *host, int port, int rank,
                           const char *addr, int timeout_ms) {
-  return gang_client_connect2(host, port, rank, addr, timeout_ms, nullptr);
+  return gang_client_connect3(host, port, rank, addr, timeout_ms, -1, nullptr);
+}
+
+long gang_client_generation(void *p) {
+  return static_cast<GangClient *>(p)->generation;
 }
 
 // 0 = released, 1 = gang failure (a member died), -1 = io error.
@@ -396,8 +458,12 @@ int gang_client_barrier(void *p, long epoch) {
 
 int gang_client_heartbeat(void *p) {
   auto *cli = static_cast<GangClient *>(p);
+  // Tagged when the coordinator speaks the tagged protocol: a beat
+  // from a superseded generation then earns an authoritative DEAD.
+  std::string line = "HB " + std::to_string(cli->rank);
+  if (cli->generation >= 0) line += " " + std::to_string(cli->generation);
   std::string resp;
-  if (!write_all(cli->fd, "HB " + std::to_string(cli->rank) + "\n")) return -1;
+  if (!write_all(cli->fd, line + "\n")) return -1;
   if (!read_line(cli->fd, &resp)) return -1;
   return resp == "OK" ? 0 : 1;
 }

@@ -11,9 +11,12 @@ project's measured baselines. BASELINE.json configs:
 
 Extensions beyond the reference's scope: mnist_cnn_sync (the headline),
 long_context_lm (flash kernels at seq 8192), moe_lm (switch MoE vs its
-dense twin), hogwild_wire (dill vs framed-binary parameter-server wire
-on real sockets), hogwild_chaos (supervised recovery from one seeded
-worker kill — a gate, not just a measurement).
+dense twin, with a comm/compute budget from an analyzed XLA capture),
+hogwild_wire (dill vs framed-binary parameter-server wire on real
+sockets), hogwild_chaos (supervised recovery from one seeded worker
+kill), hogwild_chaos_soak (multi-round random kill/freeze/drop
+schedule), sharded_trace (capture→analyze→publish trace-attribution
+round-trip) — the last three are gates, not just measurements.
 
 Each bench returns a summary dict (examples/sec/chip + p50/p99 step
 times where steps exist) and appends raw per-phase records to a JSONL
@@ -106,7 +109,8 @@ def _xla_cost_per_step(epoch, epoch1, state, batch):
 
 def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
                       warmup: int = 3, chunks: int = 8,
-                      repeats: int = 5, with_cost_analysis: bool = False) -> dict:
+                      repeats: int = 5, with_cost_analysis: bool = False,
+                      with_trace: bool = False) -> dict:
     """Shared harness for the sync-DP configs: whole chunks of steps
     fused into one compiled call (the framework's fast path).
 
@@ -199,6 +203,38 @@ def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
     # artifact as a negative one, and leaving it in wildly inflates
     # rate_best/rate_spread_pct (ADVICE r04) — anything below 20% of
     # the positive median is jitter, not a measurement.
+    # Optional trace-attribution phase (with_trace): capture an XLA
+    # profile of two more fused-epoch calls and machine-read it
+    # (obs.xprof) — the per-collective comm/compute budget then rides
+    # the record beside the rate, and the same xprof.* metrics land on
+    # the bus for --telemetry-dump / /metrics parity.
+    trace_rec = None
+    _sp_trace = None
+    if with_trace:
+        import tempfile
+
+        from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+        with tele.span("bench/trace") as _sp_trace, \
+                tempfile.TemporaryDirectory() as td:
+            with profile_run(td, telemetry=tele) as prof_handle:
+                for i in range(2):
+                    with step_annotation(i, telemetry=tele):
+                        state, metrics = epoch(state, batch)
+                    _materialize(metrics.loss)
+            _sp_trace.synced = True
+        analysis = prof_handle["analysis"]
+        if analysis is not None:
+            trace_rec = {
+                "comm_s": round(analysis.comm_s, 6),
+                "comm_fraction": round(analysis.comm_fraction, 4),
+                "overlap_fraction": round(analysis.overlap_fraction, 4),
+                "collective_s": {k: round(v, 6)
+                                 for k, v in analysis.family_s().items()},
+                "collective_counts": analysis.family_counts(),
+                "n_collective_events": analysis.n_collective_events,
+            }
+
     good = [s for s in slopes if s > 0]
     if good:
         floor = 0.2 * float(np.median(good))
@@ -233,6 +269,14 @@ def _sync_epoch_bench(spec, x, y, batch_size: int, iters: int = 30,
     }
     if cost is not None:
         out.update(cost)
+    if trace_rec is not None:
+        # The comm/compute budget section: seconds join the phase
+        # breakdown, the attribution detail rides beside it.
+        out["comm_budget"] = trace_rec
+        out["phase_s"]["trace"] = round(_sp_trace.duration_s, 3)
+        out["phase_s"]["comm_s"] = trace_rec["comm_s"]
+        out["comm_fraction"] = trace_rec["comm_fraction"]
+        out["overlap_fraction"] = trace_rec["overlap_fraction"]
     return out
 
 
@@ -665,6 +709,389 @@ def bench_hogwild_chaos() -> dict:
     }
 
 
+def bench_sharded_trace() -> dict:
+    """Trace-attribution gate (``make bench-trace``): capture an XLA
+    profile of the GSPMD sharded trainer, machine-read it offline
+    (:mod:`sparktorch_tpu.obs.xprof`), and FAIL unless
+
+    - the analysis finds >=1 collective event (on any multi-device
+      backend — GSPMD must have inserted tp/dp collectives),
+    - the per-step slice wall reconciles with the bus's
+      ``train_sharded/step`` span wall within tolerance (the step
+      annotations live INSIDE those spans), and
+    - a real ``/metrics`` scrape equals the JSONL telemetry dump for
+      every published ``xprof.*`` metric (capture -> analyze ->
+      publish round-trip, one source of truth).
+
+    The record reports the comm/compute budget the capture exposed:
+    ``comm_s`` / ``comm_fraction`` / ``overlap_fraction`` plus the
+    per-family breakdown and top ops."""
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+    from sparktorch_tpu.obs import (
+        Telemetry,
+        parse_prometheus,
+        read_jsonl,
+    )
+    from sparktorch_tpu.obs.prom import sanitize_name
+    from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
+    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+    from sparktorch_tpu.train.sharded import (
+        create_sharded_state,
+        make_sharded_train_step,
+        shard_batch,
+    )
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    # This config executes a collective-bearing GSPMD program; the
+    # persistent compile cache is disarmed for it on CPU (executing a
+    # deserialized collective executable segfaults jax 0.4.37 CPU —
+    # see tests/conftest.py / ROADMAP).
+    old_cache = jax.config.jax_compilation_cache_dir
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        tele = Telemetry(run_id="bench_sharded_trace")
+        devices = jax.devices()
+        n_dev = len(devices)
+        steps = 6
+        with tele.span("bench/data") as _sp_data:
+            rng = np.random.default_rng(0)
+            bsz = 4 * n_dev
+            batch = DataBatch(
+                x=np.asarray(rng.integers(0, 256, (bsz, 16)).astype(np.int32)),
+                y=np.asarray(rng.integers(0, 2, (bsz,)).astype(np.int32)),
+                w=np.ones((bsz,), np.float32),
+            )
+        with tele.span("bench/init") as _sp_init:
+            # tp=2 when it divides the rig: tensor-parallel all-reduces
+            # INSIDE the step, beside the dp gradient reduction.
+            mesh = build_mesh(MeshConfig(tp=2) if n_dev % 2 == 0
+                              else MeshConfig(), devices)
+            module = SequenceClassifier(tiny_transformer())
+            spec = ModelSpec(module=module, loss="cross_entropy",
+                             optimizer="adam", optimizer_params={"lr": 1e-3})
+            tx = spec.make_optimizer()
+            state, shardings = create_sharded_state(
+                spec, mesh, jax.random.key(0), sample_x=batch.x[:1], tx=tx,
+            )
+        with tempfile.TemporaryDirectory() as profile_dir:
+            step = make_sharded_train_step(
+                module.apply, spec.loss_fn(), tx, mesh, shardings,
+                profile_dir=profile_dir, telemetry=tele,
+            )
+            sharded = shard_batch(batch, mesh)
+            with tele.span("bench/compile_warmup") as _sp_warm:
+                # Compile OUTSIDE the capture (run.jitted directly, no
+                # annotation/span), so the trace holds steady steps.
+                with _set_mesh(mesh):
+                    state, m = step.jitted(state, sharded)
+                _sp_warm.sync(m.loss)
+            with tele.span("bench/measure") as _sp_measure:
+                for _ in range(steps):
+                    state, metrics = step(state, sharded)
+                    # Block per step so each step's device work drains
+                    # inside its attribution slice.
+                    jax.block_until_ready(metrics.loss)
+                _sp_measure.synced = True
+            analysis = step.finish()
+
+        # ---- gates -------------------------------------------------------
+        if analysis is None or analysis.n_device_events == 0:
+            raise AssertionError(
+                "trace analysis found no device events — the runtime "
+                "emitted no usable capture"
+            )
+        if n_dev > 1 and analysis.n_collective_events < 1:
+            raise AssertionError(
+                f"no collectives found in a {n_dev}-device sharded step "
+                f"(families seen: {analysis.family_counts()})"
+            )
+        # Span paths are slash-joined by nesting: the step spans ran
+        # inside this config's bench/measure span.
+        span = tele.span_rollup("bench/measure/train_sharded/step")
+        step_wall = analysis.wall_s
+        span_wall = span["sum"]
+        # The annotations sit INSIDE the spans: their wall can never
+        # exceed the span wall (beyond clock jitter), and must account
+        # for most of it (the span adds only set_mesh + bookkeeping).
+        tol = max(0.5 * span_wall, 0.02)
+        if not (0 < step_wall <= span_wall + 0.005) or \
+                abs(span_wall - step_wall) > tol:
+            raise AssertionError(
+                f"step-slice wall {step_wall:.4f}s does not reconcile "
+                f"with bus span wall {span_wall:.4f}s (tol {tol:.4f}s)"
+            )
+        if len(analysis.steps) != steps or span["count"] != steps:
+            raise AssertionError(
+                f"expected {steps} steps: trace has "
+                f"{len(analysis.steps)}, bus has {span['count']}"
+            )
+
+        # ---- /metrics scrape == JSONL dump parity ------------------------
+        with GangMetricsExporter(telemetry=tele) as exporter:
+            with urllib.request.urlopen(exporter.url + "/metrics") as resp:
+                scraped = parse_prometheus(resp.read().decode())
+        with tempfile.TemporaryDirectory() as d:
+            import os
+
+            dump_path = os.path.join(d, "telemetry.jsonl")
+            snap = tele.dump(dump_path)
+            (snap_read,) = read_jsonl(dump_path)
+        mismatches = []
+        for flat, val in snap["counters"].items():
+            if not flat.startswith("xprof."):
+                continue
+            name, _, labels = flat.partition("{")
+            key = "sparktorch_" + sanitize_name(name)
+            if labels:
+                k, _, v = labels[:-1].partition("=")
+                key += f'{{{k}="{v}"}}'
+            got = scraped.get(key)
+            if got != val or snap_read["counters"].get(flat) != val:
+                mismatches.append((flat, val, got,
+                                   snap_read["counters"].get(flat)))
+        n_hists = 0
+        for flat, roll in snap["histograms"].items():
+            if not flat.startswith("xprof."):
+                continue
+            n_hists += 1
+            name, _, labels = flat.partition("{")
+            key = "sparktorch_" + sanitize_name(name)
+            lbl = ""
+            if labels:
+                k, _, v = labels[:-1].partition("=")
+                lbl = f'{{{k}="{v}"}}'
+            if scraped.get(f"{key}_count{lbl}") != float(roll["count"]) or \
+                    snap_read["histograms"][flat]["count"] != roll["count"]:
+                mismatches.append((flat, roll["count"]))
+        if mismatches or n_hists == 0:
+            raise AssertionError(
+                f"xprof /metrics scrape vs JSONL dump mismatch "
+                f"(histograms seen: {n_hists}): {mismatches}"
+            )
+
+        return {
+            "config": "sharded_trace", "unit": "comm_fraction",
+            "value": round(analysis.comm_fraction, 4),
+            "comm_fraction": round(analysis.comm_fraction, 4),
+            "overlap_fraction": round(analysis.overlap_fraction, 4),
+            "comm_s": round(analysis.comm_s, 6),
+            "compute_s": round(analysis.compute_s, 6),
+            "collective_s": {k: round(v, 6)
+                             for k, v in analysis.family_s().items()},
+            "collective_counts": analysis.family_counts(),
+            "n_collective_events": analysis.n_collective_events,
+            "n_steps": len(analysis.steps),
+            "n_chips": n_dev,
+            "reconcile": {"steps_wall_s": round(step_wall, 6),
+                          "span_wall_s": round(span_wall, 6)},
+            "top_ops": analysis.top_ops[:5],
+            "scrape_parity": "ok",
+            "phase_s": {
+                "data": round(_sp_data.duration_s, 3),
+                "init": round(_sp_init.duration_s, 3),
+                "compile_warmup": round(_sp_warm.duration_s, 3),
+                "measure": round(_sp_measure.duration_s, 3),
+                "comm_s": round(analysis.comm_s, 6),
+            },
+        }
+    finally:
+        if jax.default_backend() == "cpu":
+            jax.config.update("jax_compilation_cache_dir", old_cache)
+
+
+def bench_hogwild_chaos_soak(rounds: int = 4, iters: int = 16,
+                             freeze_rounds: int = 2,
+                             worker_steps: int = 60) -> dict:
+    """Chaos SOAK gate (``make bench-chaos-soak``): a seeded random
+    kill/freeze/drop schedule over many supervised rounds — the
+    multi-fault recovery races ``bench-chaos``'s single kill cannot
+    catch. Two legs:
+
+    - **hogwild leg** (kills + connection drops): each round runs
+      ``train_async`` over real sockets under a random schedule —
+      maybe kill a random worker at a random step, drop 0-2 keep-alive
+      connections. Every round must complete with restart count ==
+      that round's injected kills and an EXACT record count (a killed
+      attempt flushes nothing; the rerun repays it — no double
+      counting).
+    - **freeze leg** (stall preemption): supervised heartbeat-emitting
+      workers where a random rank's first attempt goes silent mid-run;
+      the barrier deadline must preempt it (cooperatively — the worker
+      polls its cancel event) and the restarted attempt must finish.
+
+    FAILS (raises) on any mismatch: restarts != kills,
+    stall preemptions != freezes, lost/duplicated records."""
+    import tempfile
+    import threading
+    import time as _time
+
+    import jax
+
+    from sparktorch_tpu.ft import ChaosConfig, FtPolicy, RestartPolicy, inject
+    from sparktorch_tpu.ft.policy import BarrierPolicy
+    from sparktorch_tpu.ft.supervisor import Supervisor, ThreadWorker
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.obs import Telemetry
+    from sparktorch_tpu.obs.heartbeat import HeartbeatEmitter
+    from sparktorch_tpu.train.hogwild import train_async
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(7)
+    tele = Telemetry(run_id="bench_chaos_soak")
+    t_start = time.perf_counter()
+
+    # ---- hogwild leg: kills + drops over real sockets --------------------
+    n_workers = len(jax.devices())
+    spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3},
+                     input_shape=(784,))
+    x = rng.normal(0, 1, (1024, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (1024,)).astype(np.int32)
+    policy = FtPolicy(restart=RestartPolicy(max_restarts=2,
+                                            backoff_base_s=0.05), seed=0)
+    train_async(spec, x, labels=y, iters=4, mini_batch=64, seed=0)  # warmup
+
+    kills_total = drops_total = 0
+    per_round = []
+    for r in range(rounds):
+        kills = {}
+        if rng.random() < 0.75:
+            kills[int(rng.integers(0, n_workers))] = int(rng.integers(2, 8))
+        drops = int(rng.integers(0, 3))
+        cfg = ChaosConfig(kill_worker_at=kills, drop_connections=drops,
+                          seed=r)
+        with inject(cfg, telemetry=tele) as inj:
+            result = train_async(spec, x, labels=y, iters=iters,
+                                 mini_batch=64, seed=r, transport="http",
+                                 supervise=True, ft_policy=policy,
+                                 telemetry=tele)
+        restarts = (result.summary or {}).get("ft", {}).get(
+            "restarts_total", 0)
+        fired = [e["site"] for e in inj.events]
+        if restarts != len(kills):
+            raise AssertionError(
+                f"soak round {r}: {restarts} restarts != "
+                f"{len(kills)} injected kills (chaos events: {fired})"
+            )
+        if fired.count("worker.step") != len(kills):
+            raise AssertionError(
+                f"soak round {r}: kill schedule {kills} but fired {fired}"
+            )
+        # Exact records — the no-double-counting invariant: a killed
+        # attempt flushes nothing, the restarted attempt reruns the
+        # whole round assignment.
+        if len(result.metrics) != iters * n_workers:
+            raise AssertionError(
+                f"soak round {r}: {len(result.metrics)} records != "
+                f"{iters * n_workers} expected"
+            )
+        kills_total += len(kills)
+        drops_total += fired.count("transport.request")
+        per_round.append({"round": r, "kills": list(kills.items()),
+                          "drops": fired.count("transport.request"),
+                          "restarts": int(restarts)})
+
+    restarts_bus = sum(
+        v for k, v in tele.snapshot()["counters"].items()
+        if k.startswith("ft_restarts_total")
+    )
+    if restarts_bus != kills_total:
+        raise AssertionError(
+            f"bus ft_restarts_total {restarts_bus} != {kills_total} "
+            "injected kills across the soak (double-counted restarts?)"
+        )
+
+    # ---- freeze leg: stall-preempted heartbeats through the supervisor --
+    freezes_total = 0
+    for r in range(freeze_rounds):
+        freeze_rank = int(rng.integers(0, 3))
+        freeze_at = int(rng.integers(3, 8))
+        freezes_total += 1
+        with tempfile.TemporaryDirectory() as hb_dir:
+            done_counts = {i: 0 for i in range(3)}
+            lock = threading.Lock()
+
+            def make_start(rank):
+                def start(attempt):
+                    # Freshen the slot BEFORE the handle exists: the
+                    # frozen file's stale age must not instantly
+                    # re-preempt the restarted attempt.
+                    HeartbeatEmitter(hb_dir, rank).beat()
+
+                    def target(cancel):
+                        emitter = HeartbeatEmitter(hb_dir, rank)
+                        frozen = attempt == 0 and rank == freeze_rank
+                        for s in range(worker_steps):
+                            if cancel.is_set():
+                                return  # cooperative preemption
+                            if not (frozen and s >= freeze_at):
+                                emitter.notify_step(s)
+                            _time.sleep(0.02)
+                        with lock:
+                            done_counts[rank] += 1
+                        emitter.close()
+
+                    return ThreadWorker(f"soak{rank}", target,
+                                        pass_cancel=True)
+
+                return start
+
+            fpol = FtPolicy(
+                restart=RestartPolicy(max_restarts=2, backoff_base_s=0.05),
+                barrier=BarrierPolicy(deadline_s=0.3), seed=r,
+            )
+            sup = Supervisor(policy=fpol, telemetry=tele,
+                             heartbeat_dir=hb_dir, name=f"soak_freeze{r}")
+            for rank in range(3):
+                sup.add(str(rank), make_start(rank), rank=rank)
+            sup.run(deadline_s=60)
+            if any(v != 1 for v in done_counts.values()):
+                raise AssertionError(
+                    f"freeze round {r}: completion counts {done_counts} "
+                    "(a worker finished twice or never — double-counted)"
+                )
+
+    preempts = sum(
+        v for k, v in tele.snapshot()["counters"].items()
+        if k.startswith("ft_stall_preemptions_total")
+    )
+    if preempts != freezes_total:
+        raise AssertionError(
+            f"{preempts} stall preemptions != {freezes_total} injected "
+            "freezes"
+        )
+    restarts_all = sum(
+        v for k, v in tele.snapshot()["counters"].items()
+        if k.startswith("ft_restarts_total")
+    )
+    if restarts_all != kills_total + freezes_total:
+        raise AssertionError(
+            f"total restarts {restarts_all} != kills {kills_total} + "
+            f"freezes {freezes_total}"
+        )
+    return {
+        "config": "hogwild_chaos_soak", "unit": "restarts",
+        "value": int(restarts_all),
+        "rounds": rounds, "freeze_rounds": freeze_rounds,
+        "kills": int(kills_total), "freezes": int(freezes_total),
+        "drops": int(drops_total),
+        "restarts": int(restarts_all),
+        "stall_preemptions": int(preempts),
+        "records_exact": True,
+        "n_chips": n_workers,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "per_round": per_round,
+    }
+
+
 def _bert_flops_accounting(module, batch: int, seq: int) -> dict:
     """Honest model-FLOPs accounting for the BERT classifier.
 
@@ -989,8 +1416,12 @@ def bench_moe_lm() -> dict:
                          optimizer="adamw", optimizer_params={"lr": 3e-4})
 
     ids = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    # with_trace: the MoE leg's record carries the per-collective
+    # comm/compute budget (dispatch/combine collectives vs expert
+    # compute) from an analyzed XLA capture — comm_s/comm_fraction/
+    # overlap_fraction in the phase budget, per the obs ISSUE.
     moe = _sync_epoch_bench(spec_for(8), ids[:, :-1], ids[:, 1:], batch,
-                            iters=6, warmup=2, chunks=2)
+                            iters=6, warmup=2, chunks=2, with_trace=True)
     dense = _sync_epoch_bench(spec_for(0), ids[:, :-1], ids[:, 1:], batch,
                               iters=6, warmup=2, chunks=2)
     return {
@@ -1013,6 +1444,8 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "resnet18_hogwild": bench_resnet18_hogwild,
     "hogwild_wire": bench_hogwild_wire,
     "hogwild_chaos": bench_hogwild_chaos,
+    "hogwild_chaos_soak": bench_hogwild_chaos_soak,
+    "sharded_trace": bench_sharded_trace,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,

@@ -29,7 +29,7 @@ from sparktorch_tpu.ft.policy import (
 )
 
 _LAZY = ("Supervisor", "ThreadWorker", "ProcessWorker", "WorkerFailed",
-         "supervise_run")
+         "WorkerPreempted", "supervise_run")
 
 
 def __getattr__(name):

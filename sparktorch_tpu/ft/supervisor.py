@@ -48,6 +48,17 @@ class WorkerFailed(RuntimeError):
     """A supervised worker failed and its restart budget is spent."""
 
 
+class WorkerPreempted(RuntimeError):
+    """A cooperative worker observed its cancel event and stopped.
+
+    Raised by worker loops that poll the :class:`ThreadWorker` cancel
+    event (the hogwild ``_worker_loop`` polls between windows), so a
+    supervisor ``kill()`` — straggler preemption, stall deadline —
+    actually stops a thread-based worker instead of merely flagging
+    it. The supervisor treats the death of a ``preempting`` worker as
+    a restart under budget, whatever it raised."""
+
+
 class ThreadWorker:
     """Thread-backed worker handle. The target either returns (clean
     exit) or raises (failure — captured, surfaced via ``error``).

@@ -56,7 +56,7 @@ def _save_stages_dir(path: str, kind: str, stages: Sequence):
         with open(os.path.join(path, "stages", fname), "wb") as f:
             dill.dump(stage, f)
     with open(os.path.join(path, _MANIFEST), "w") as f:
-        json.dump(
+        json.dump(  # lint-obs: ok (persistence manifest, not trace events)
             {
                 "format_version": _FORMAT_VERSION,
                 "kind": kind,

@@ -193,6 +193,13 @@ def _lib():
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
         ctypes.c_int, ctypes.POINTER(ctypes.c_int),
     ]
+    lib.gang_client_connect3.restype = ctypes.c_void_p
+    lib.gang_client_connect3.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.gang_client_generation.restype = ctypes.c_long
+    lib.gang_client_generation.argtypes = [ctypes.c_void_p]
     lib.gang_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.gang_client_heartbeat.argtypes = [ctypes.c_void_p]
     lib.gang_client_world.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
@@ -204,13 +211,24 @@ class GangCoordinator:
     """Driver-side coordinator. world_size hosts must register.
 
     ``rejoin_grace_ms`` (default 0 = disabled, the original behavior):
-    after a member is declared dead, a re-registration arriving within
-    this window opens a NEW GENERATION — the failure latch clears,
-    membership and barrier counts reset, and every rank must register
-    again — so a supervisor-restarted gang reforms on the same
-    coordinator instead of being refused with DEAD forever. Outside
-    the window, re-registration stays refused (a dead gang must not be
-    silently resurrected under survivors that already saw DEAD).
+    after a member is declared dead, a FRESH re-registration arriving
+    within this window opens a NEW GENERATION — the failure latch
+    clears, membership and barrier counts reset, and every rank must
+    register again — so a supervisor-restarted gang reforms on the
+    same coordinator instead of being refused with DEAD forever.
+    Outside the window, re-registration stays refused (a dead gang
+    must not be silently resurrected under survivors that already saw
+    DEAD).
+
+    REG/HB lines are GENERATION-TAGGED (closing the rejoin-grace race
+    filed by the ft PR): clients echo the generation they joined, and
+    the coordinator refuses stale tags with DEAD — so a survivor of
+    the failed generation whose heartbeat socket broke cannot open
+    (or sneak into) the new generation while its old-generation peers
+    still hold live connections; only genuinely fresh registrations
+    (supervisor-restarted ranks) reform the gang. Untagged lines from
+    old clients keep the pre-tag semantics, so mixed-version gangs
+    interoperate.
     """
 
     def __init__(self, world_size: int, port: int = 0,
@@ -278,18 +296,29 @@ class GangWorker:
         # members[rank] server-side while the gang is healthy; once the
         # gang has failed the coordinator refuses with DEAD).
         self._endpoint = (host, port, address, timeout_ms)
+        # Fresh registration (generation tag -1: "never joined"); the
+        # OK reply tells us which generation we joined, and every
+        # subsequent HB/reconnect-REG carries it — so the coordinator
+        # can refuse us once the gang reforms without us. -1 after
+        # connect means an old untagged coordinator (legacy lines).
         self._handle = self._lib.gang_client_connect(
             host.encode(), port, rank, address.encode(), timeout_ms
         )
         if not self._handle:
             raise GangFailure(f"rank {rank}: cannot register with {host}:{port}")
+        self._generation = int(self._lib.gang_client_generation(self._handle))
         # Separate connection for heartbeats: the main connection can
         # be parked inside a blocking barrier read, and interleaving
         # HB traffic on the same socket would steal its GO line. A
         # worker without a working heartbeat channel has no failure
         # detection at all — refuse to construct rather than run blind.
-        self._hb_handle = self._lib.gang_client_connect(
-            host.encode(), port, rank, address.encode(), timeout_ms
+        # Tagged with the generation the main channel just joined: a
+        # reformed gang must not accept this worker's second REG as a
+        # fresh member.
+        status = ctypes.c_int(-1)
+        self._hb_handle = self._lib.gang_client_connect3(
+            host.encode(), port, rank, address.encode(), timeout_ms,
+            self._generation, ctypes.byref(status),
         )
         if not self._hb_handle:
             self._lib.gang_client_close(self._handle)
@@ -346,13 +375,19 @@ class GangWorker:
                 # spends one of the remaining strikes. A DEAD reply on
                 # the re-REG is authoritative (the coordinator now
                 # refuses to resurrect a slot in a failed gang): stop
-                # probing and declare the gang lost immediately.
+                # probing and declare the gang lost immediately. The
+                # re-REG carries OUR generation, so if the gang failed
+                # and reformed without us during the rejoin grace
+                # window, the coordinator refuses this survivor with
+                # DEAD instead of letting its fresh-looking REG open
+                # (or join) a generation its peers aren't in — the
+                # rejoin-grace race the generation tags exist to close.
                 host, port, address, timeout_ms = self._endpoint
                 status = ctypes.c_int(-1)
-                fresh = self._lib.gang_client_connect2(
+                fresh = self._lib.gang_client_connect3(
                     host.encode(), port, self.rank,
                     address.encode(), min(timeout_ms, 2000),
-                    ctypes.byref(status),
+                    self._generation, ctypes.byref(status),
                 ) or None
                 if status.value == 1:
                     self._hb_dead.set()
@@ -381,6 +416,13 @@ class GangWorker:
         heartbeat reply flips to DEAD gang-wide, so survivors learn of
         a peer's death within one heartbeat interval)."""
         return self._hb_dead.is_set()
+
+    @property
+    def generation(self) -> int:
+        """The gang generation this worker registered into (see
+        :class:`GangCoordinator`); -1 when the coordinator predates
+        the generation-tagged protocol."""
+        return self._generation
 
     def check(self) -> None:
         """Raise :class:`GangFailure` if the gang has failed. Cheap

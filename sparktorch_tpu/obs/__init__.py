@@ -28,6 +28,12 @@ from sparktorch_tpu.obs.heartbeat import (
     read_heartbeats,
 )
 from sparktorch_tpu.obs.log import get_logger
+from sparktorch_tpu.obs.xprof import (
+    TraceAnalysis,
+    TraceParseError,
+    analyze_and_publish,
+    analyze_trace,
+)
 
 __all__ = [
     "Span",
@@ -46,4 +52,8 @@ __all__ = [
     "gang_report",
     "read_heartbeats",
     "get_logger",
+    "TraceAnalysis",
+    "TraceParseError",
+    "analyze_and_publish",
+    "analyze_trace",
 ]

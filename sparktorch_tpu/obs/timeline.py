@@ -1,0 +1,230 @@
+"""Human-readable step timelines and comm/compute budgets.
+
+The CLI twin of :mod:`sparktorch_tpu.obs.xprof`: render a captured
+XLA trace (or a telemetry JSONL dump that already carries published
+``xprof.*`` metrics) as a per-step timeline and budget report a human
+can read in a terminal, no TensorBoard required.
+
+    python -m sparktorch_tpu.obs.timeline /tmp/trace_dir
+    python -m sparktorch_tpu.obs.timeline run_telemetry.jsonl
+    python -m sparktorch_tpu.obs.timeline trace.json.gz --json
+
+Rendering is pure string-building (testable offline); only the CLI
+entry prints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional
+
+from sparktorch_tpu.obs.xprof import (
+    TraceAnalysis,
+    TraceParseError,
+    analyze_trace,
+)
+
+_BAR_W = 40
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:.2f}ms"
+
+
+def _budget_bar(window_s: float, compute_s: float, comm_s: float,
+                overlap_s: float, width: int = _BAR_W) -> str:
+    """Budget bar (not a temporal strip): ``#`` compute-only, ``=``
+    comm overlapped with compute, ``~`` comm-only (exposed), ``.``
+    idle/unattributed — each sized by its share of the step window."""
+    if window_s <= 0:
+        return "." * width
+    comp_only = max(compute_s - overlap_s, 0.0)
+    comm_only = max(comm_s - overlap_s, 0.0)
+    cells = []
+    for sym, val in (("#", comp_only), ("=", overlap_s), ("~", comm_only)):
+        cells.append((sym, int(round(width * min(val / window_s, 1.0)))))
+    used = sum(n for _, n in cells)
+    if used > width:  # rounding spill: trim the largest segment
+        sym, n = max(cells, key=lambda c: c[1])
+        cells[cells.index((sym, n))] = (sym, n - (used - width))
+        used = width
+    return "".join(sym * n for sym, n in cells) + "." * (width - used)
+
+
+def render_report(analysis: TraceAnalysis, top: int = 10) -> str:
+    """Per-step timeline + whole-run budget for one analyzed trace."""
+    d = analysis.to_dict()
+    lines = [
+        f"trace: {d['source']}",
+        f"steps: {d['n_steps']}   device events: {d['n_device_events']}"
+        f"   collective events: {d['n_collective_events']}"
+        f"   unattributed: {d['n_unattributed']}",
+        "",
+        f"{'step':>6} {'wall':>10} {'window':>10} {'compute':>10}"
+        f" {'comm':>10} {'comm%':>7} {'ovl%':>6}  budget"
+        f" [#=compute ==hidden-comm ~=exposed-comm]",
+    ]
+    for s in d["steps"]:
+        step = "-" if s["step"] is None else str(s["step"])
+        lines.append(
+            f"{step:>6} {_fmt_ms(s['wall_s']):>10}"
+            f" {_fmt_ms(s['window_s']):>10}"
+            f" {_fmt_ms(s['compute_s']):>10} {_fmt_ms(s['comm_s']):>10}"
+            f" {100 * s['comm_fraction']:>6.1f} {100 * s['overlap_fraction']:>5.1f}"
+            f"  {_budget_bar(s['window_s'], s['compute_s'], s['comm_s'], s['overlap_s'])}"
+        )
+        for fam, sec in sorted(s["families"].items()):
+            lines.append(
+                f"{'':>6}   {fam:<16} {_fmt_ms(sec):>10}"
+                f"  x{s['counts'].get(fam, 0)}"
+            )
+    lines += [
+        "",
+        f"budget: wall {_fmt_ms(d['wall_s'])} | compute "
+        f"{_fmt_ms(d['compute_s'])} | comm {_fmt_ms(d['comm_s'])} "
+        f"({100 * d['comm_fraction']:.1f}% of windows, "
+        f"{100 * d['overlap_fraction']:.1f}% hidden under compute)",
+    ]
+    if d["collective_s"]:
+        lines.append("collectives:")
+        for fam, sec in sorted(d["collective_s"].items(),
+                               key=lambda kv: -kv[1]):
+            lines.append(f"  {fam:<16} {_fmt_ms(sec):>10}"
+                         f"  x{d['collective_counts'].get(fam, 0)}")
+    else:
+        lines.append("collectives: none found in this capture")
+    if d["top_ops"]:
+        # Device-seconds (summed across lanes), so concurrent lanes
+        # add up here — unlike the union walls above.
+        lines.append(f"top {min(top, len(d['top_ops']))} ops by total "
+                     f"device time:")
+        for i, op in enumerate(d["top_ops"][:top]):
+            lines.append(
+                f"  {i + 1:>2}. {op['name']:<32} {op['family']:<12}"
+                f" {_fmt_ms(op['total_s']):>10}  x{op['count']}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Snapshot (JSONL dump) rendering
+# ---------------------------------------------------------------------------
+
+
+def _xprof_snapshot(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The last snapshot record that carries published xprof metrics."""
+    for rec in reversed(records):
+        hists = rec.get("histograms") or {}
+        if any(k.startswith("xprof.") for k in hists):
+            return rec
+    return None
+
+
+def render_snapshot_report(snap: Dict[str, Any]) -> str:
+    """Budget report from a telemetry snapshot (``--telemetry-dump``
+    JSONL or a ``/telemetry`` read) — the roll-up view of the same
+    numbers :meth:`TraceAnalysis.publish` put on the bus, so a dump
+    and a trace render the same budget."""
+    hists = snap.get("histograms", {})
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def roll(name: str) -> Dict[str, Any]:
+        return hists.get(name) or {"count": 0, "sum": 0.0, "p50": None,
+                                   "p99": None}
+
+    wall, comm = roll("xprof.step_wall_s"), roll("xprof.comm_s")
+    compute = roll("xprof.compute_s")
+    lines = [
+        f"run: {snap.get('run_id', '?')} (telemetry snapshot)",
+        f"steps analyzed: {wall['count']}",
+        f"step wall: sum {_fmt_ms(wall['sum'])}"
+        + (f", p50 {_fmt_ms(wall['p50'])}, p99 {_fmt_ms(wall['p99'])}"
+           if wall["p50"] is not None else ""),
+        f"compute:   sum {_fmt_ms(compute['sum'])}",
+        f"comm:      sum {_fmt_ms(comm['sum'])}",
+    ]
+    cf = gauges.get("xprof.comm_fraction_run")
+    of = gauges.get("xprof.overlap_fraction_run")
+    if cf is not None:
+        lines.append(f"comm fraction: {100 * cf:.1f}%"
+                     + (f" ({100 * of:.1f}% hidden under compute)"
+                        if of is not None else ""))
+    fams = [(k, v) for k, v in hists.items()
+            if k.startswith("xprof.collective_time_s{")]
+    if fams:
+        lines.append("collectives (per-step seconds, rolled up):")
+        for key, r in sorted(fams, key=lambda kv: -kv[1].get("sum", 0.0)):
+            fam = key.split("op=", 1)[-1].rstrip("}")
+            n = counters.get(f"xprof.collectives_total{{op={fam}}}", 0)
+            lines.append(
+                f"  {fam:<16} sum {_fmt_ms(r.get('sum', 0.0)):>10}"
+                + (f"  p50 {_fmt_ms(r['p50'])}" if r.get("p50") is not None
+                   else "")
+                + f"  events {int(n)}"
+            )
+    else:
+        lines.append("collectives: none published")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _looks_like_jsonl(path: str) -> bool:
+    return path.endswith((".jsonl", ".ndjson"))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sparktorch_tpu.obs.timeline",
+        description="Per-step timeline and comm/compute budget from an "
+                    "XLA trace capture or a telemetry JSONL dump.",
+    )
+    parser.add_argument("path", help="trace.json(.gz), a profile log "
+                                     "dir, or a telemetry .jsonl dump")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw analysis dict as JSON")
+    parser.add_argument("--top", type=int, default=10,
+                        help="top-K slowest ops to list")
+    parser.add_argument("--step-name", default="train_step",
+                        help="step annotation event name")
+    args = parser.parse_args(argv)
+
+    if _looks_like_jsonl(args.path):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(args.path)
+        except OSError as e:
+            print(f"error: {e}")
+            return 1
+        snap = _xprof_snapshot(records)
+        if snap is None:
+            print(f"no snapshot with xprof.* metrics in {args.path}")
+            return 1
+        print(json.dumps(snap) if args.json else render_snapshot_report(snap),
+              end="" if not args.json else "\n")
+        return 0
+
+    try:
+        analysis = analyze_trace(args.path, step_name=args.step_name,
+                                 top_k=max(args.top, 15))
+    except TraceParseError as e:
+        print(f"error: {e}")
+        return 1
+    if args.json:
+        print(json.dumps(analysis.to_dict()))
+    else:
+        print(render_report(analysis, top=args.top), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:  # e.g. piped into `head`
+        raise SystemExit(0)

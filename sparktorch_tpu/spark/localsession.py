@@ -577,7 +577,7 @@ class JavaMLWriter:
             "stopWords": jobj.getStopWords(),
         }
         with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f)
+            json.dump(meta, f)  # lint-obs: ok (JVM-parity metadata)
 
 
 class JavaMLReader:
@@ -824,7 +824,7 @@ class _PipelineWriter:
             "stages": [_stage_to_entry(s) for s in self._target.stages],
         }
         with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f)
+            json.dump(meta, f)  # lint-obs: ok (JVM-parity metadata)
 
 
 class Pipeline(Estimator):

@@ -335,6 +335,7 @@ def _worker_loop(
     grad_windows=None,
     phase_out: Optional[List[dict]] = None,
     telemetry=None,
+    cancel=None,
 ):
     """One worker's training loop.
 
@@ -347,6 +348,15 @@ def _worker_loop(
     verbose/early-stop demands a value NOW): a ``float()`` per
     iteration serializes the pipeline on a host round-trip that costs
     more than the gradient step itself on remote-attached chips.
+
+    ``cancel`` (a ``threading.Event``, wired by the supervised path)
+    is polled BETWEEN windows: a supervisor ``kill()`` — straggler or
+    stall preemption — stops the worker at the next window boundary
+    with :class:`WorkerPreempted` instead of being silently ignored
+    (threads cannot be preempted mid-dispatch; the window is the
+    preemption unit, like it is the staleness unit). A preempted
+    attempt flushes no records, so the restarted attempt's rerun
+    keeps counts exact.
     """
     tele = telemetry or get_telemetry()
     log = get_logger("sparktorch_tpu.train.hogwild")
@@ -368,6 +378,12 @@ def _worker_loop(
         # in the push's materialize fence)
         t_loop0 = time.perf_counter()
         while it < iters:
+            if cancel is not None and cancel.is_set():
+                from sparktorch_tpu.ft.supervisor import WorkerPreempted
+
+                raise WorkerPreempted(
+                    f"worker {worker_id} preempted at iter {it}"
+                )
             # Chaos injection point: a seeded config can kill THIS
             # worker at step N (ChaosKill lands in `errors` like any
             # real failure; under supervision it triggers a restart).
@@ -643,19 +659,23 @@ def train_async(
                                  name=f"hogwild_round{round_idx}")
 
                 def make_start(args):
-                    def target():
+                    def target(cancel):
                         # A fresh error list per attempt: the loop
                         # traps its failure there; re-raising hands it
                         # to the supervisor's handle as THE failure.
+                        # `cancel` is the handle's kill() event — the
+                        # loop polls it between windows, so straggler
+                        # and stall preemption genuinely stop a
+                        # thread-based worker.
                         attempt_errors: List[BaseException] = []
                         _worker_loop(*args, attempt_errors, push_every,
                                      eval_loss, grad_windows,
-                                     phase_stats, tele)
+                                     phase_stats, tele, cancel)
                         if attempt_errors:
                             raise attempt_errors[0]
 
                     return lambda attempt: ThreadWorker(
-                        f"w{args[0]}", target
+                        f"w{args[0]}", target, pass_cancel=True
                     )
 
                 for args in worker_args:

@@ -2641,7 +2641,7 @@ def train_distributed_pipeline(
             os.makedirs(checkpoint_dir, exist_ok=True)
             tmp = layout_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(layout, f)
+                json.dump(layout, f)  # lint-obs: ok (checkpoint layout)
             os.replace(tmp, layout_path)
 
     # PipelineState checkpoints like TrainState (step-indexed orbax

@@ -154,7 +154,11 @@ def make_sharded_train_step(
     bus. With ``profile_dir`` set, the FIRST call starts an XLA
     profiler trace there; the caller owns the loop here (no trainer
     driver), so it ends the capture with ``run.finish()`` — also safe
-    to call when no profile was requested.
+    to call when no profile was requested. Stopping the capture
+    auto-analyzes it (:mod:`sparktorch_tpu.obs.xprof`): per-step
+    collective/compute attribution lands on the bus as ``xprof.*``
+    metrics, and ``finish()`` returns the :class:`TraceAnalysis`
+    (None when nothing was captured).
     """
 
     pass_w = _accepts_example_w(apply_fn)
@@ -224,12 +228,12 @@ def make_sharded_train_step(
     from sparktorch_tpu.utils.tracing import profile_run, step_annotation
 
     tele = telemetry or get_telemetry()
-    loop_state = {"calls": 0, "profiler": None}
+    loop_state = {"calls": 0, "profiler": None, "handle": None}
 
     def run(state, batch):
         if profile_dir and loop_state["profiler"] is None:
             loop_state["profiler"] = profile_run(profile_dir, telemetry=tele)
-            loop_state["profiler"].__enter__()
+            loop_state["handle"] = loop_state["profiler"].__enter__()
         step_no = loop_state["calls"]
         loop_state["calls"] += 1
         with _set_mesh(mesh), tele.span("train_sharded/step"), \
@@ -237,10 +241,13 @@ def make_sharded_train_step(
             return jitted(state, batch)
 
     def finish():
-        """End an in-flight XLA trace capture (no-op otherwise)."""
+        """End an in-flight XLA trace capture (no-op otherwise) and
+        return the published :class:`TraceAnalysis` (or None)."""
         profiler, loop_state["profiler"] = loop_state["profiler"], None
         if profiler is not None:
             profiler.__exit__(None, None, None)
+        handle, loop_state["handle"] = loop_state["handle"], None
+        return handle["analysis"] if handle else None
 
     # Introspection hooks (tests assert on the compiled HLO — e.g. that
     # the MoE layout constraints actually lower to all-to-alls).

@@ -41,12 +41,23 @@ def trace_viewer_url(log_dir: str, host: str = "localhost",
 
 
 @contextlib.contextmanager
-def profile_run(log_dir: Optional[str], telemetry=None) -> Iterator[None]:
+def profile_run(log_dir: Optional[str], telemetry=None,
+                analyze: bool = True) -> Iterator[dict]:
     """Capture an XLA profiler trace for the enclosed block when
     ``log_dir`` is set; no-op otherwise. View with TensorBoard or
-    xprof."""
+    xprof.
+
+    At stop time the capture is ALSO machine-read (``analyze=True``):
+    :func:`sparktorch_tpu.obs.xprof.analyze_and_publish` slices the
+    Chrome trace by the per-step annotations, attributes collective vs
+    compute time, and publishes ``xprof.*`` metrics onto the bus — so
+    the trace becomes queryable (``/metrics``, JSONL dumps) instead of
+    TensorBoard-only. Yields a handle dict whose ``"analysis"`` key
+    holds the :class:`TraceAnalysis` after exit (None when profiling
+    is off, analysis is disabled, or the runtime emitted no trace)."""
+    handle: dict = {"analysis": None}
     if not log_dir:
-        yield
+        yield handle
         return
     import time
 
@@ -60,7 +71,7 @@ def profile_run(log_dir: Optional[str], telemetry=None) -> Iterator[None]:
     t0 = time.perf_counter()
     jax.profiler.start_trace(log_dir)
     try:
-        yield
+        yield handle
     finally:
         jax.profiler.stop_trace()
         # log_dir is NOT a label: label values must stay simple tokens
@@ -75,6 +86,13 @@ def profile_run(log_dir: Optional[str], telemetry=None) -> Iterator[None]:
         tele.info("tracing.trace_url", url)
         tele.event("profile_trace", log_dir=log_dir, trace_url=url,
                    view_cmd=f"tensorboard --logdir {log_dir}")
+        if analyze:
+            # Failure-safe by contract (a missing/torn capture logs
+            # and bumps xprof.analyze_failures, never raises).
+            from sparktorch_tpu.obs.xprof import analyze_and_publish
+
+            handle["analysis"] = analyze_and_publish(log_dir,
+                                                     telemetry=tele)
 
 
 def step_annotation(step: int, telemetry=None):

@@ -1,0 +1,124 @@
+"""Regenerate the golden xprof trace fixtures.
+
+Two fixtures live beside this script:
+
+- ``synthetic_overlap.trace.json.gz`` — a handcrafted Chrome trace
+  with EXACT known attribution (step walls, per-family unions, an
+  overlap window, a TPU-style "XLA Ops" lane restriction including a
+  module-envelope lane that must be ignored, and one pre-step op that
+  must land unattributed). The expected numbers are asserted digit-
+  for-digit in tests/test_obs_xprof.py; change one side only in
+  lockstep with the other.
+- ``cpu_allreduce.trace.json.gz`` — a REAL capture: the repo's own
+  tracing hooks (profile_run + step_annotation) around 3 steps of a
+  dp×tp-sharded matmul on the 8-device CPU backend, which lowers to
+  two all-reduces per step per device lane. Event COUNTS are
+  deterministic for the frozen file; timings are whatever the
+  generating machine did.
+
+Regenerate (from the repo root):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tests/fixtures/xprof/make_fixtures.py
+"""
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def write_synthetic() -> str:
+    us = 1.0  # event times below are already microseconds
+
+    def m(pid, tid, kind, name):
+        return {"ph": "M", "pid": pid, "tid": tid, "name": kind,
+                "args": {"name": name}}
+
+    def x(pid, tid, name, ts, dur, **args):
+        e = {"ph": "X", "pid": pid, "tid": tid, "name": name,
+             "ts": ts * us, "dur": dur * us}
+        if args:
+            e["args"] = args
+        return e
+
+    events = [
+        m(1, 0, "process_name", "/device:TPU:0"),
+        m(1, 10, "thread_name", "XLA Ops #1"),
+        m(1, 11, "thread_name", "XLA Ops #2"),
+        m(1, 12, "thread_name", "XLA Modules"),
+        m(2, 0, "process_name", "/host:CPU"),
+        m(2, 20, "thread_name", "python"),
+        # Step markers (step_num serialized as a string, like the
+        # real profiler does).
+        x(2, 20, "train_step", 1000, 1000, step_num="0"),
+        x(2, 20, "train_step", 2000, 800, step_num="1"),
+        # Pre-step op: must land unattributed.
+        x(1, 10, "fusion.0", 500, 100),
+        # Step 0: compute 600us, all-reduce 500us, overlap 200us.
+        x(1, 10, "fusion.1", 1000, 600),
+        x(1, 11, "all-reduce.7", 1400, 500),
+        # Module envelope on a non-op lane: must be ignored entirely.
+        x(1, 12, "jit_step", 1000, 900),
+        # Step 1: compute 300us; ag 200us + a2a 100us + two concurrent
+        # reduce-scatters (union 100us, count 2); zero overlap.
+        x(1, 10, "fusion.2", 2100, 300),
+        x(1, 11, "all-gather.3", 2400, 200),
+        x(1, 10, "all-to-all.9", 2600, 100),
+        x(1, 10, "reduce-scatter.4", 2700, 100),
+        x(1, 11, "reduce-scatter.5", 2700, 100),
+        # Host noise that must never classify as device work.
+        x(2, 20, "ThunkExecutor::Execute (wait for completion)", 1000, 500),
+        x(2, 20, "$profiler.py:91 start_trace", 900, 10),
+    ]
+    path = os.path.join(HERE, "synthetic_overlap.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"displayTimeUnit": "ns", "traceEvents": events}, f)
+    return path
+
+
+def write_cpu_capture() -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from sparktorch_tpu.obs.telemetry import Telemetry
+    from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+    assert len(jax.devices()) == 8, "run with 8 forced CPU devices"
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+
+    @jax.jit
+    def step(xx, ww):
+        y = xx @ ww
+        return jnp.sum(y * y)
+
+    x = jax.device_put(
+        np.random.default_rng(0).normal(size=(64, 128)).astype(np.float32),
+        NamedSharding(mesh, P("dp", None)))
+    w = jax.device_put(
+        np.random.default_rng(1).normal(size=(128, 128)).astype(np.float32),
+        NamedSharding(mesh, P(None, "tp")))
+    step(x, w).block_until_ready()  # compile outside the capture
+
+    tele = Telemetry(run_id="fixture")
+    with tempfile.TemporaryDirectory() as d:
+        with profile_run(d, telemetry=tele, analyze=False):
+            for i in range(3):
+                with step_annotation(i, telemetry=tele):
+                    step(x, w).block_until_ready()
+        (src,) = glob.glob(os.path.join(d, "**", "*.trace.json.gz"),
+                           recursive=True)
+        dst = os.path.join(HERE, "cpu_allreduce.trace.json.gz")
+        shutil.copyfile(src, dst)
+    return dst
+
+
+if __name__ == "__main__":
+    print(write_synthetic())
+    print(write_cpu_capture())

@@ -460,6 +460,97 @@ def test_hogwild_chaos_kill_supervised_recovers_and_converges():
     assert snap["histograms"]["ft_recovery_latency_s{worker=1}"]["count"] == 1
 
 
+def test_worker_loop_preemption_stops_slowed_worker():
+    """Hogwild preemption made real (ROADMAP ft follow-up): a
+    supervisor kill() on a thread-based worker sets the cancel event,
+    and ``_worker_loop`` POLLS it between windows — so a deliberately
+    slowed worker (a transport whose pulls crawl) stops within a
+    window boundary instead of grinding through its whole iteration
+    budget with the preempt silently ignored."""
+    from sparktorch_tpu.ft import WorkerPreempted
+    from sparktorch_tpu.train.hogwild import _worker_loop, make_grad_step
+    from sparktorch_tpu.utils.data import DataBatch
+
+    import jax
+
+    class SlowTransport:
+        """Each pull crawls: without preemption, 200 iters x 0.05s
+        would take ~10s."""
+
+        def __init__(self):
+            self.stats = None
+            self.pulls = 0
+
+        def pull(self, have_version):
+            self.pulls += 1
+            time.sleep(0.05)
+            if have_version < 0:
+                params = {"w": np.zeros((4,), np.float32)}
+                return 0, params
+            return None
+
+        def push(self, grads):
+            pass
+
+        def post_loss(self, loss):
+            return False
+
+    rng = np.random.default_rng(0)
+    shard = DataBatch(
+        x=np.asarray(rng.normal(size=(32, 4)).astype(np.float32)),
+        y=np.asarray(rng.integers(0, 2, (32,)).astype(np.int32)),
+        w=np.ones((32,), np.float32),
+    )
+
+    def apply_fn(variables, x, mutable=None):
+        preds = x @ variables["params"]["w"].reshape(4, 1)
+        return (preds, {}) if mutable is not None else preds
+
+    def loss_fn(preds, y):
+        return (preds[:, 0] - y) ** 2
+
+    grad_step = make_grad_step(apply_fn, loss_fn)
+    transport = SlowTransport()
+    errors, records = [], []
+    started = threading.Event()
+
+    def target(cancel):
+        started.set()
+        _worker_loop(0, jax.devices()[0], transport, grad_step, {},
+                     shard, None, 200, 0, False, 0, records, errors,
+                     cancel=cancel)
+
+    t0 = time.perf_counter()
+    w = ThreadWorker("slow", target, pass_cancel=True)
+    assert started.wait(5)
+    while transport.pulls < 2 and time.perf_counter() - t0 < 5:
+        time.sleep(0.01)
+    w.kill()                       # the supervisor's preempt path
+    w.join(timeout=5)
+    assert not w.is_alive(), "preempt ignored: worker still running"
+    assert time.perf_counter() - t0 < 8.0  # nowhere near the full loop
+    assert errors and isinstance(errors[0], WorkerPreempted)
+    # A preempted attempt flushes NO records (the restarted attempt
+    # reruns the assignment, keeping counts exact).
+    assert records == []
+
+
+@pytest.mark.slow
+def test_chaos_soak_multi_round_random_schedule():
+    """The chaos SOAK (`make bench-chaos-soak`, shrunk): a seeded
+    random kill/freeze/drop schedule over multiple supervised rounds
+    — every round completes, restart count == injected kills, stall
+    preemptions == injected freezes, record counts exact (no metric
+    double-counting)."""
+    from sparktorch_tpu.bench import bench_hogwild_chaos_soak
+
+    rec = bench_hogwild_chaos_soak(rounds=3, iters=8, freeze_rounds=1,
+                                   worker_steps=40)
+    assert rec["restarts"] == rec["kills"] + rec["freezes"]
+    assert rec["stall_preemptions"] == rec["freezes"]
+    assert rec["records_exact"] is True
+
+
 def test_sync_chaos_kill_resumes_from_latest_checkpoint(tmp_path):
     """Sync recovery: a seeded kill interrupts a checkpointed DP run;
     ``supervise_run`` restarts the attempt, auto-discovers the latest

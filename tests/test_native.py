@@ -280,6 +280,70 @@ def test_gang_rejoin_grace_window_new_generation():
         r1.close()
 
 
+def _gang_line(port: int, line: str) -> str:
+    """Speak one raw protocol line to the coordinator (wire-level
+    tests: exact REG/HB tagging semantics, mixed-version lines)."""
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(line.encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(256)
+            if not chunk:
+                break
+            buf += chunk
+    return buf.decode().strip()
+
+
+def test_gang_generation_tagged_protocol_closes_rejoin_race():
+    # The ROADMAP race: during the rejoin grace window, a SURVIVOR of
+    # the failed generation whose heartbeat socket broke re-REGs — and
+    # before tagging, that re-REG opened the new generation while its
+    # old-generation peers still held live connections. Now REG/HB
+    # carry the client's generation and the coordinator refuses stale
+    # ones: only FRESH registrations (supervisor-restarted ranks)
+    # reform the gang. Untagged lines keep the pre-tag semantics for
+    # mixed-version gangs.
+    with GangCoordinator(world_size=2, heartbeat_timeout_ms=300,
+                         rejoin_grace_ms=20_000) as coord:
+        w0 = GangWorker("127.0.0.1", coord.port, 0, "a:1",
+                        heartbeat_interval_s=0.1)
+        assert w0.generation == 0  # the OK reply carries the generation
+        w1 = GangWorker("127.0.0.1", coord.port, 1, "b:1",
+                        heartbeat_interval_s=0.1)
+        w1.suspend_heartbeat()
+        deadline = time.time() + 10
+        while not coord.failed and time.time() < deadline:
+            time.sleep(0.05)
+        assert coord.failed and coord.generation == 0
+
+        # THE RACE, closed: the survivor's reconnect-REG is tagged
+        # with its (failed) generation — refused with DEAD, and the
+        # gang is NOT resurrected under it.
+        assert _gang_line(coord.port, "REG 0 a:1 0\n") == "DEAD"
+        assert coord.failed and coord.generation == 0
+        w0.close()
+        w1.close()
+
+        # A genuinely FRESH registration (a supervisor-restarted rank,
+        # tag -1) opens the new generation within the grace window.
+        r1 = GangWorker("127.0.0.1", coord.port, 1, "b:1",
+                        heartbeat_interval_s=0.1)
+        assert coord.generation == 1 and not coord.failed
+        assert r1.generation == 1
+
+        # Stale lines from generation-0 survivors are refused; the
+        # reformed generation's own lines (and untagged old-client
+        # lines) work.
+        assert _gang_line(coord.port, "REG 0 a:1 0\n") == "DEAD"
+        assert _gang_line(coord.port, "HB 1 0\n") == "DEAD"
+        assert _gang_line(coord.port, "HB 1 1\n") == "OK"
+        assert _gang_line(coord.port, "REG 0 c:1\n") == "OK 2 1"
+        assert coord.registered == 2
+        r1.close()
+
+
 def test_trainer_aborts_when_peer_host_dies():
     # Trainer-level failure path: a multi-host run where a PEER host
     # dies mid-training. The survivor's training loop polls the gang

@@ -219,6 +219,51 @@ def test_prometheus_empty_snapshot_and_label_escaping():
     assert r'path="a\"b\\c"' in text
 
 
+def test_prometheus_label_newline_quote_backslash_roundtrip():
+    """Exposition-text escaping edge cases: a label value holding all
+    three reserved characters must render as ONE line per series (a
+    raw newline would tear the series and corrupt the whole scrape)
+    and survive parse_prometheus. The realistic carrier is an info()
+    annotation (URLs, build strings) whose value rides as a label."""
+    tele = Telemetry()
+    nasty = 'quote:" back:\\ nl:\nend'
+    tele.counter("edge_total", 3, labels={"msg": nasty})
+    tele.info("edge_info", nasty)
+    text = render_prometheus(tele.snapshot())
+    for line in text.splitlines():
+        if "edge" in line and not line.startswith("#"):
+            # Escaped forms present, raw newline absent (splitlines
+            # would have torn the series otherwise).
+            assert r"\n" in line and r"\"" in line and r"\\" in line
+    parsed = parse_prometheus(text)
+    key = ('sparktorch_edge_total'
+           '{msg="quote:\\" back:\\\\ nl:\\nend"}')
+    assert parsed[key] == 3.0
+    info_line = [ln for ln in text.splitlines()
+                 if ln.startswith("sparktorch_edge_info")]
+    assert len(info_line) == 1 and info_line[0].endswith(" 1.0")
+
+
+def test_prometheus_empty_histogram_rollup_renders():
+    """A count-0 roll-up (empty histogram: null quantiles) must render
+    without quantile lines — and without crashing — while keeping the
+    _sum/_count series a scraper expects."""
+    snap = {"histograms": {"empty_h": {
+        "count": 0, "sum": 0.0, "mean": None, "min": None, "max": None,
+        "p50": None, "p95": None, "p99": None,
+    }}}
+    text = render_prometheus(snap)
+    assert "quantile" not in text
+    assert "sparktorch_empty_h_sum 0.0" in text
+    assert "sparktorch_empty_h_count 0.0" in text
+    parsed = parse_prometheus(text)
+    assert parsed["sparktorch_empty_h_count"] == 0.0
+    # The read-side twin: an unobserved histogram rolls up to the same
+    # empty shape instead of raising.
+    roll = Telemetry().histogram("never_observed")
+    assert roll["count"] == 0 and roll["p50"] is None
+
+
 # ---------------------------------------------------------------------------
 # MetricsRecorder as a bus adapter (satellites 1 + 2)
 # ---------------------------------------------------------------------------
@@ -410,11 +455,21 @@ def test_sharded_step_tracing_and_telemetry(tmp_path):
         profile_dir=profile_dir, telemetry=tele,
     )
     sharded = shard_batch(batch, mesh)
+    # Compile OUTSIDE the capture (run.jitted, no annotation): a
+    # capture that contains the multi-second compile floods the
+    # profiler's event buffer and later step markers get dropped.
+    from sparktorch_tpu.parallel.compat import set_mesh
+
+    with set_mesh(mesh):
+        state, _ = step.jitted(state, sharded)
     for _ in range(2):
         state, metrics = step(state, sharded)
     assert np.isfinite(float(metrics.loss))
-    step.finish()
-    step.finish()  # idempotent
+    # Drain before stopping the capture so the final step's device
+    # work lands inside it (the converter drops incomplete steps).
+    jax.block_until_ready(metrics.loss)
+    analysis = step.finish()
+    assert step.finish() is None  # idempotent
 
     assert tele.span_rollup("train_sharded/step")["count"] == 2
     assert tele.counter_value("tracing.annotated_steps") == 2.0
@@ -426,6 +481,13 @@ def test_sharded_step_tracing_and_telemetry(tmp_path):
     captured = [os.path.join(d, f) for d, _, fs in os.walk(profile_dir)
                 for f in fs]
     assert captured, "no trace files written"
+    # finish() machine-read the capture it just stopped: the analysis
+    # is returned AND its attribution landed on the same bus (the
+    # full offline contract lives in test_obs_xprof.py).
+    if analysis is not None and analysis.n_device_events > 0:
+        assert len(analysis.steps) == 2
+        assert tele.counter_value("xprof.analyses_total") == 1.0
+        assert tele.histogram("xprof.step_wall_s")["count"] == 2
 
 
 # ---------------------------------------------------------------------------
