@@ -5,8 +5,8 @@
 PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
-	bench-wire bench-chaos bench-chaos-soak bench-trace cluster-up \
-	clean lint-obs
+	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
+	cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -25,6 +25,12 @@ install:
 #   data must flow through the sinks (atomicity, append semantics,
 #   scrape==dump parity). Genuine non-telemetry persistence writes
 #   carry a `lint-obs: ok (<why>)` annotation.
+# - no ad-hoc urllib scraping of exporter routes outside obs/:
+#   readers of /metrics, /telemetry, /heartbeats, /gang must go
+#   through obs.collector.scrape_json/scrape_text (shared timeout,
+#   error taxonomy, degradation discipline). Non-scrape urllib use
+#   (e.g. the dill data wire) carries a `lint-obs: ok (<why>)`
+#   annotation.
 lint-obs:
 	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
 		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:' \
@@ -47,6 +53,15 @@ lint-obs:
 	if [ -n "$$hits" ]; then \
 		echo "lint-obs: raw json.dump outside obs/ (use obs sinks, or"; \
 		echo "annotate non-telemetry persistence with 'lint-obs: ok (<why>)'):"; \
+		echo "$$hits"; exit 1; \
+	fi; \
+	hits=$$(grep -rn --include='*.py' 'urllib\.request\.urlopen' \
+		sparktorch_tpu/ | grep -v '^sparktorch_tpu/obs/' \
+		| grep -v 'lint-obs: ok'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: ad-hoc urllib scraping outside obs/ (use"; \
+		echo "obs.collector.scrape_json/scrape_text, or annotate a"; \
+		echo "non-scrape data wire with 'lint-obs: ok (<why>)'):"; \
 		echo "$$hits"; exit 1; \
 	fi; echo "lint-obs OK"
 
@@ -114,6 +129,16 @@ bench-trace:
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
 	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
 	$(PYTHON) -m sparktorch_tpu.bench --config sharded_trace
+
+# Gang-observability gate: spin local rank exporters, run the fleet
+# collector, and FAIL unless the merged scrape reconciles with the
+# per-rank scrapes (every series rank/host-labeled, values and sums
+# equal), the merged xprof gang budget reconciles with the per-rank
+# analyses (families sum, step walls max, skew >= 0), and a seeded
+# truncated capture trips the xprof.capture_truncated warning exactly
+# once. Backend-free — no devices needed.
+bench-gang-obs:
+	$(PYTHON) -m sparktorch_tpu.bench --config gang_obs
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

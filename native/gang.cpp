@@ -16,10 +16,24 @@
 // Exposed as a C API for ctypes (no pybind11 in this toolchain).
 //
 // Protocol (line-based over TCP):
-//   REG <rank> <addr> [<gen>]\n -> OK <world_size> <gen>\n | ERR <msg>\n
+//   REG <rank> <addr> [<gen>] [<run>]\n
+//       -> OK <world_size> <gen> [<run_id>]\n | ERR <msg>\n | DEAD\n
 //   BAR <epoch>\n               -> GO\n | DEAD\n
 //   WLD\n                       -> <rank0 addr>,<rank1 addr>,...\n
 //   HB <rank> [<gen>]\n         -> OK\n | DEAD\n
+//
+// The optional <run> token (run-id-tagged protocol, backward-
+// compatible exactly like the generation tag below) correlates
+// per-rank observability streams: a coordinator started with a
+// run_id announces it in every OK reply, so each rank stamps the
+// SAME gang-unique id on its spans/events/heartbeats and a fleet
+// collector can join them. A client that already knows a run id
+// echoes it on REG ("-" = no claim); a MISMATCHED claim is refused
+// with "ERR run" — a rank from a different gang's run must not
+// silently register into this one (e.g. a stale supervisor pointing
+// at a recycled host:port). Old clients never send the token and old
+// coordinators ignore it (sscanf stops early), so mixed-version
+// gangs keep working.
 //
 // The optional <gen> tag (generation-tagged protocol) closes the
 // rejoin-grace race: REG/HB lines carry the generation the client
@@ -67,6 +81,10 @@ struct GangState {
   // re-register) instead of being refused with DEAD. 0 = disabled
   // (the original latch-forever behavior, still the default).
   int rejoin_grace_ms = 0;
+  // Gang-unique run id announced on OK replies (empty = untagged, the
+  // pre-run-id wire format). Immutable after start; safe to read
+  // without the mutex.
+  std::string run_id;
   std::mutex mu;
   std::condition_variable cv;
   std::map<int, std::string> members;         // rank -> addr
@@ -120,12 +138,24 @@ void handle_conn(GangServer *srv, int fd) {
       int rank = -1;
       long gen = -1;  // -1 = fresh/untagged
       char addr[1024] = {0};
-      int n_tok = sscanf(line.c_str(), "REG %d %1023s %ld", &rank, addr, &gen);
+      char run[128] = {0};  // "-"/absent = no run-id claim
+      int n_tok = sscanf(line.c_str(), "REG %d %1023s %ld %127s", &rank, addr,
+                         &gen, run);
       if (n_tok < 2 || rank < 0 || rank >= st.world_size) {
         write_all(fd, "ERR bad rank\n");
         continue;
       }
       if (n_tok == 2) gen = -1;
+      // A run-id CLAIM that contradicts this coordinator's run is a
+      // rank from a different gang incarnation (stale supervisor,
+      // recycled endpoint): refuse before touching membership. No
+      // claim ("-"/absent) always passes — first registration happens
+      // before the client can know the id.
+      if (n_tok >= 4 && run[0] != '\0' && strcmp(run, "-") != 0 &&
+          !st.run_id.empty() && st.run_id != run) {
+        write_all(fd, "ERR run\n");
+        continue;
+      }
       // A failed gang stays failed — UNLESS a supervisor is restarting
       // ranks and the rejoin grace window is open: then the first
       // FRESH re-registration after the failure opens a new generation
@@ -174,8 +204,10 @@ void handle_conn(GangServer *srv, int fd) {
       }
       if (ok) {
         st.cv.notify_all();
-        write_all(fd, "OK " + std::to_string(st.world_size) + " " +
-                          std::to_string(cur_gen) + "\n");
+        std::string reply = "OK " + std::to_string(st.world_size) + " " +
+                            std::to_string(cur_gen);
+        if (!st.run_id.empty()) reply += " " + st.run_id;
+        write_all(fd, reply + "\n");
       } else {
         write_all(fd, "DEAD\n");
       }
@@ -280,6 +312,7 @@ struct GangClient {
   int fd = -1;
   int rank = -1;
   long generation = -1;  // generation joined; -1 = old/untagged server
+  std::string run_id;    // announced by the OK reply; empty = untagged
 };
 
 int dial(const char *host, int port, int timeout_ms) {
@@ -314,12 +347,13 @@ int dial(const char *host, int port, int timeout_ms) {
 
 extern "C" {
 
-void *gang_server_start2(int port, int world_size, int heartbeat_timeout_ms,
-                         int rejoin_grace_ms) {
+void *gang_server_start3(int port, int world_size, int heartbeat_timeout_ms,
+                         int rejoin_grace_ms, const char *run_id) {
   auto *srv = new GangServer();
   srv->state.world_size = world_size;
   srv->state.heartbeat_timeout_ms = heartbeat_timeout_ms;
   srv->state.rejoin_grace_ms = rejoin_grace_ms;
+  if (run_id) srv->state.run_id = run_id;
   srv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (srv->listen_fd < 0) {
     delete srv;
@@ -345,9 +379,23 @@ void *gang_server_start2(int port, int world_size, int heartbeat_timeout_ms,
   return srv;
 }
 
+void *gang_server_start2(int port, int world_size, int heartbeat_timeout_ms,
+                         int rejoin_grace_ms) {
+  // Pre-run-id entry: untagged coordinator (legacy OK replies).
+  return gang_server_start3(port, world_size, heartbeat_timeout_ms,
+                            rejoin_grace_ms, nullptr);
+}
+
 void *gang_server_start(int port, int world_size, int heartbeat_timeout_ms) {
   // Original 3-arg entry: rejoin grace disabled (latch-forever).
   return gang_server_start2(port, world_size, heartbeat_timeout_ms, 0);
+}
+
+int gang_server_run_id(void *p, char *buf, int buflen) {
+  const std::string &rid = static_cast<GangServer *>(p)->state.run_id;
+  if (static_cast<int>(rid.size()) + 1 > buflen) return -1;
+  memcpy(buf, rid.c_str(), rid.size() + 1);
+  return static_cast<int>(rid.size());
 }
 
 int gang_server_port(void *p) { return static_cast<GangServer *>(p)->port; }
@@ -400,32 +448,44 @@ void gang_server_stop(void *p) {
 // (the gang already failed — authoritative, do not retry), -1 = io/ERR.
 // generation: the tag sent on the REG line (-1 = fresh, never joined;
 // >=0 = rejoining member of that generation — refused once stale).
-void *gang_client_connect3(const char *host, int port, int rank,
+// run_id: the run claim sent on the REG line (null/empty/"-" = none);
+// a mismatched claim is refused by run-id-tagged coordinators.
+void *gang_client_connect4(const char *host, int port, int rank,
                            const char *addr, int timeout_ms,
-                           long generation, int *status) {
+                           long generation, const char *run_id,
+                           int *status) {
   if (status) *status = -1;
   int fd = dial(host, port, timeout_ms);
   if (fd < 0) return nullptr;
   auto *cli = new GangClient{fd, rank};
   std::string msg = "REG " + std::to_string(rank) + " " + addr + " " +
-                    std::to_string(generation) + "\n";
+                    std::to_string(generation);
+  if (run_id && run_id[0] != '\0') msg += std::string(" ") + run_id;
   std::string resp;
-  if (!write_all(fd, msg) || !read_line(fd, &resp) ||
+  if (!write_all(fd, msg + "\n") || !read_line(fd, &resp) ||
       resp.rfind("OK", 0) != 0) {
     if (status && resp == "DEAD") *status = 1;
     close(fd);
     delete cli;
     return nullptr;
   }
-  // "OK <world_size> <generation>" from a tagged coordinator; an old
-  // coordinator replies "OK <world_size>" and the client stays
-  // untagged (generation -1 -> legacy HB lines).
+  // "OK <world_size> <generation> [<run_id>]" from a tagged
+  // coordinator; an old coordinator replies "OK <world_size>" and the
+  // client stays untagged (generation -1 -> legacy HB lines).
   long ws = 0, gen = -1;
-  if (sscanf(resp.c_str(), "OK %ld %ld", &ws, &gen) == 2) {
-    cli->generation = gen;
-  }
+  char run[128] = {0};
+  int n_tok = sscanf(resp.c_str(), "OK %ld %ld %127s", &ws, &gen, run);
+  if (n_tok >= 2) cli->generation = gen;
+  if (n_tok >= 3) cli->run_id = run;
   if (status) *status = 0;
   return cli;
+}
+
+void *gang_client_connect3(const char *host, int port, int rank,
+                           const char *addr, int timeout_ms,
+                           long generation, int *status) {
+  return gang_client_connect4(host, port, rank, addr, timeout_ms, generation,
+                              nullptr, status);
 }
 
 void *gang_client_connect2(const char *host, int port, int rank,
@@ -440,6 +500,13 @@ void *gang_client_connect(const char *host, int port, int rank,
 
 long gang_client_generation(void *p) {
   return static_cast<GangClient *>(p)->generation;
+}
+
+int gang_client_run_id(void *p, char *buf, int buflen) {
+  const std::string &rid = static_cast<GangClient *>(p)->run_id;
+  if (static_cast<int>(rid.size()) + 1 > buflen) return -1;
+  memcpy(buf, rid.c_str(), rid.size() + 1);
+  return static_cast<int>(rid.size());
 }
 
 // 0 = released, 1 = gang failure (a member died), -1 = io error.

@@ -709,6 +709,105 @@ def bench_hogwild_chaos() -> dict:
     }
 
 
+def _prior_comm_budget(config: str,
+                       root: Optional[str] = None) -> Optional[dict]:
+    """The most recent PRIOR round's record for ``config`` that
+    carries a comm budget — scanned from the retained round artifacts
+    (repo-root ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` and the
+    ``benchmarks/*.jsonl`` logs). None when no prior record exists
+    (first armed round: the drift gate skips cleanly)."""
+    import glob
+    import os
+    import re
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates: List[tuple] = []
+
+    def _round_of(path: str) -> int:
+        m = re.search(r"_r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    # Recency key: the record's own ISO timestamp first (sortable as a
+    # string; records without one sort oldest), the artifact's round
+    # number as the tiebreak. NEVER the raw filename — lexicographic
+    # basenames would rank any lowercase benchmarks/*.jsonl above
+    # every BENCH_r*.json and compare the gate against a stale round.
+    def _consider(rec, path):
+        if isinstance(rec, dict) and rec.get("config") == config \
+                and rec.get("comm_fraction") is not None:
+            candidates.append(((str(rec.get("ts") or ""),
+                                _round_of(path)), rec))
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))
+                       + glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # a torn artifact never blocks the bench
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        for rec in (parsed if isinstance(parsed, list) else [parsed]):
+            _consider(rec, path)
+    for path in sorted(glob.glob(os.path.join(root, "benchmarks",
+                                              "*.jsonl"))):
+        try:
+            with open(path) as f:
+                rows = [json.loads(line) for line in f if line.strip()]
+        except (OSError, ValueError):
+            continue
+        for rec in rows:
+            _consider(rec, path)
+    if not candidates:
+        return None
+    return max(candidates, key=lambda c: c[0])[1]
+
+
+def _check_comm_drift(config: str, comm_fraction: float,
+                      overlap_fraction: float) -> dict:
+    """The comm-fraction drift gate (ROADMAP follow-up, armed): now
+    that ``sharded_trace`` and ``moe_lm`` record ``comm_budget`` every
+    round, compare this run's fractions against the previous round's
+    record and FAIL (AssertionError -> ``make bench-trace`` fails)
+    when an overlap was lost (overlap_fraction collapsed — e.g. a
+    remat change serializing the dp all-reduce) or comm grew to
+    dominate the step. Skips cleanly when no prior record exists.
+    Tolerance is absolute on the fractions (default 0.25 — generous
+    for CPU-rig jitter; tighten via SPARKTORCH_TPU_COMM_DRIFT_TOL on
+    stable hardware). Returns the drift record the bench attaches."""
+    import os
+
+    tol = float(os.environ.get("SPARKTORCH_TPU_COMM_DRIFT_TOL", "0.25"))
+    prior = _prior_comm_budget(config)
+    if prior is None:
+        return {"status": "no_prior_record", "tolerance": tol}
+    prior_cf = float(prior["comm_fraction"])
+    prior_of = float(prior.get("overlap_fraction", 0.0))
+    drift = {
+        "status": "checked",
+        "tolerance": tol,
+        "prior_ts": prior.get("ts"),
+        "prior_comm_fraction": round(prior_cf, 4),
+        "prior_overlap_fraction": round(prior_of, 4),
+        "comm_fraction_delta": round(comm_fraction - prior_cf, 4),
+        "overlap_fraction_delta": round(overlap_fraction - prior_of, 4),
+    }
+    if prior_of - overlap_fraction > tol:
+        raise AssertionError(
+            f"{config}: overlap_fraction regressed "
+            f"{prior_of:.3f} -> {overlap_fraction:.3f} "
+            f"(lost overlap beyond the {tol} tolerance) — a comm that "
+            f"was hidden under compute is now exposed; drift: {drift}"
+        )
+    if comm_fraction - prior_cf > tol:
+        raise AssertionError(
+            f"{config}: comm_fraction regressed "
+            f"{prior_cf:.3f} -> {comm_fraction:.3f} "
+            f"(comm grew beyond the {tol} tolerance); drift: {drift}"
+        )
+    return drift
+
+
 def bench_sharded_trace() -> dict:
     """Trace-attribution gate (``make bench-trace``): capture an XLA
     profile of the GSPMD sharded trainer, machine-read it offline
@@ -727,7 +826,6 @@ def bench_sharded_trace() -> dict:
     ``comm_s`` / ``comm_fraction`` / ``overlap_fraction`` plus the
     per-family breakdown and top ops."""
     import tempfile
-    import urllib.request
 
     import jax
 
@@ -737,6 +835,7 @@ def bench_sharded_trace() -> dict:
         Telemetry,
         parse_prometheus,
         read_jsonl,
+        scrape_text,
     )
     from sparktorch_tpu.obs.prom import sanitize_name
     from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
@@ -836,8 +935,7 @@ def bench_sharded_trace() -> dict:
 
         # ---- /metrics scrape == JSONL dump parity ------------------------
         with GangMetricsExporter(telemetry=tele) as exporter:
-            with urllib.request.urlopen(exporter.url + "/metrics") as resp:
-                scraped = parse_prometheus(resp.read().decode())
+            scraped = parse_prometheus(scrape_text(exporter.url + "/metrics"))
         with tempfile.TemporaryDirectory() as d:
             import os
 
@@ -877,6 +975,12 @@ def bench_sharded_trace() -> dict:
                 f"(histograms seen: {n_hists}): {mismatches}"
             )
 
+        # ---- comm-fraction drift gate (vs the previous round) ------------
+        comm_drift = _check_comm_drift(
+            "sharded_trace", analysis.comm_fraction,
+            analysis.overlap_fraction,
+        )
+
         return {
             "config": "sharded_trace", "unit": "comm_fraction",
             "value": round(analysis.comm_fraction, 4),
@@ -894,6 +998,7 @@ def bench_sharded_trace() -> dict:
                           "span_wall_s": round(span_wall, 6)},
             "top_ops": analysis.top_ops[:5],
             "scrape_parity": "ok",
+            "comm_drift": comm_drift,
             "phase_s": {
                 "data": round(_sp_data.duration_s, 3),
                 "init": round(_sp_init.duration_s, 3),
@@ -905,6 +1010,217 @@ def bench_sharded_trace() -> dict:
     finally:
         if jax.default_backend() == "cpu":
             jax.config.update("jax_compilation_cache_dir", old_cache)
+
+
+def _synthetic_rank_trace(rank: int, steps: int = 2) -> dict:
+    """A deterministic per-rank Chrome-trace dict: each step has one
+    marker, one compute fusion, one all-reduce — with rank-dependent
+    timings so the merged gang budget has REAL cross-rank skew to
+    gate on (rank r's step walls are (1 + r/4)x rank 0's)."""
+    events = []
+    scale = 1.0 + rank / 4.0
+    t = 1000.0
+    for s in range(steps):
+        wall = 1000.0 * scale
+        events.append({"ph": "X", "pid": 1, "tid": 1, "name": "train_step",
+                       "ts": t, "dur": wall,
+                       "args": {"step_num": str(s)}})
+        events.append({"ph": "X", "pid": 1, "tid": 2, "name": f"fusion.{s}",
+                       "ts": t + 50, "dur": 600 * scale})
+        events.append({"ph": "X", "pid": 1, "tid": 3,
+                       "name": f"all-reduce.{s}",
+                       "ts": t + 400, "dur": 400 * scale})
+        t += wall
+    return {"traceEvents": events}
+
+
+def bench_gang_obs(n_ranks: int = 3) -> dict:
+    """Gang-observability gate (``make bench-gang-obs``): spin N local
+    rank exporters, run the fleet collector over them, and FAIL unless
+
+    - the collector's merged scrape carries EVERY per-rank series with
+      ``rank``/``host`` labels, and the merged values reconcile with
+      the per-rank scrapes (each labeled series equals its rank's own
+      scrape; the cross-rank sum equals the sum of per-rank sums);
+    - the merged xprof gang budget reconciles with the per-rank
+      analyses: per-family comm seconds SUM, per-step walls MAX,
+      cross-rank step skew >= 0 (and > 0 here — the synthetic ranks
+      are deliberately skewed);
+    - a seeded TRUNCATED capture (more steps annotated on the bus than
+      markers in the trace) trips the ``xprof.capture_truncated``
+      warning exactly once, and a complete capture trips nothing.
+
+    Backend-free (no jax device work): this is the observability
+    plane's own gate, runnable on any CI box."""
+    import os
+    import tempfile
+
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+    from sparktorch_tpu.obs import (
+        FleetCollector,
+        Telemetry,
+        analyze_trace,
+        mint_run_id,
+        parse_prometheus,
+        scrape_json,
+        scrape_text,
+    )
+    from sparktorch_tpu.obs.heartbeat import HeartbeatEmitter
+    from sparktorch_tpu.obs.xprof import analyze_and_publish
+
+    t0 = time.perf_counter()
+    run_id = mint_run_id("bench-gang-obs")
+    analyses = []
+    exporters = []
+    collector = None
+    with tempfile.TemporaryDirectory() as hb_dir:
+        try:
+            for r in range(n_ranks):
+                tele = Telemetry(run_id=run_id)
+                # Distinct per-rank counter values, so sum/match gates
+                # can't pass by accident.
+                tele.counter("bench.gang_obs_ticks", r + 1)
+                analysis = analyze_trace(_synthetic_rank_trace(r))
+                analysis.publish(tele)
+                analyses.append(analysis)
+                HeartbeatEmitter(hb_dir, rank=r, telemetry=tele,
+                                 run_id=run_id).notify_step(10 * (r + 1))
+                exporters.append(GangMetricsExporter(
+                    heartbeat_dir=hb_dir, telemetry=tele).start())
+
+            collector = FleetCollector(
+                {r: exp.url for r, exp in enumerate(exporters)},
+                run_id=run_id, poll_interval_s=0,
+            ).start(poll_loop=False)
+            collector.poll()
+
+            # ---- gate 1: merged scrape vs per-rank scrapes ---------------
+            rank_scrapes = [parse_prometheus(scrape_text(e.url + "/metrics"))
+                            for e in exporters]
+            merged_scrape = parse_prometheus(
+                scrape_text(collector.url + "/metrics"))
+            host = "127.0.0.1"
+            tick = "sparktorch_bench_gang_obs_ticks"
+            merged_sum = 0.0
+            for r, scrape in enumerate(rank_scrapes):
+                own = scrape.get(tick)
+                labeled = merged_scrape.get(
+                    f'{tick}{{host="{host}",rank="{r}"}}')
+                if own != float(r + 1) or labeled != own:
+                    raise AssertionError(
+                        f"rank {r}: merged series {labeled} != per-rank "
+                        f"scrape {own}"
+                    )
+                merged_sum += labeled
+            if merged_sum != sum(r + 1 for r in range(n_ranks)):
+                raise AssertionError(
+                    f"merged rank-labeled sum {merged_sum} != "
+                    f"{sum(r + 1 for r in range(n_ranks))}"
+                )
+            # Every rank-originated series in the merged view must
+            # carry a rank label (collector-own series are exempt).
+            merged_snap = scrape_json(collector.url + "/telemetry")
+            unlabeled = [
+                k for section in ("counters", "gauges", "histograms")
+                for k in merged_snap.get(section, {})
+                if not k.startswith(("collector.", "xprof.gang_"))
+                and "rank=" not in k
+            ]
+            if unlabeled:
+                raise AssertionError(
+                    f"merged series missing rank labels: {unlabeled[:5]}"
+                )
+
+            # ---- gate 2: gang budget reconciles with per-rank ------------
+            gang = scrape_json(collector.url + "/gang")
+            xp = gang.get("xprof")
+            if not xp or xp.get("n_ranks") != n_ranks:
+                raise AssertionError(f"gang xprof missing/short: {xp}")
+            fam_sum = {}
+            for a in analyses:
+                for fam, sec in a.family_s().items():
+                    fam_sum[fam] = fam_sum.get(fam, 0.0) + sec
+            for fam, sec in fam_sum.items():
+                got = xp["collective_s"].get(fam, 0.0)
+                if abs(got - sec) > 1e-9:
+                    raise AssertionError(
+                        f"family {fam}: gang {got} != sum {sec}"
+                    )
+            for i, step in enumerate(xp["steps"]):
+                walls = [a.steps[i].wall_s for a in analyses]
+                if abs(step["wall_s"] - max(walls)) > 1e-9:
+                    raise AssertionError(
+                        f"step {i}: gang wall {step['wall_s']} != "
+                        f"max {max(walls)}"
+                    )
+                if step["skew_s"] < 0 or \
+                        abs(step["skew_s"]
+                            - (max(walls) - min(walls))) > 1e-9:
+                    raise AssertionError(
+                        f"step {i}: skew {step['skew_s']} != "
+                        f"{max(walls) - min(walls)}"
+                    )
+            if not xp["step_skew_s"] > 0:
+                raise AssertionError(
+                    "synthetic ranks are skewed but gang skew is 0"
+                )
+            hb = gang.get("heartbeats", {})
+            if hb.get("n_ranks") != n_ranks or \
+                    hb.get("step_skew") != 10 * (n_ranks - 1):
+                raise AssertionError(f"merged heartbeat table wrong: {hb}")
+            run_ids = set(gang.get("run_ids", {}).values())
+            if run_ids != {run_id}:
+                raise AssertionError(
+                    f"run_id correlation broken: {run_ids} != {{{run_id}}}"
+                )
+
+            # ---- gate 3: truncation warning, exactly once ----------------
+            trunc_tele = Telemetry(run_id="gang_obs_trunc")
+            with tempfile.TemporaryDirectory() as td:
+                path = os.path.join(td, "host0.trace.json")
+                with open(path, "w") as f:
+                    json.dump(  # lint-obs: ok (synthetic trace fixture)
+                        _synthetic_rank_trace(0, steps=2), f)
+                # Seeded truncation: 4 steps annotated on the bus, only
+                # 2 markers survived in the capture.
+                analyze_and_publish(td, telemetry=trunc_tele,
+                                    expected_steps=4)
+                tripped = trunc_tele.counter_value(
+                    "xprof.capture_truncated_total")
+                if tripped != 1:
+                    raise AssertionError(
+                        f"truncation warning tripped {tripped}x, want 1"
+                    )
+                # A COMPLETE capture must not trip it.
+                analyze_and_publish(td, telemetry=trunc_tele,
+                                    expected_steps=2)
+                if trunc_tele.counter_value(
+                        "xprof.capture_truncated_total") != 1:
+                    raise AssertionError(
+                        "complete capture tripped the truncation warning"
+                    )
+        finally:
+            if collector is not None:
+                collector.stop()
+            for exp in exporters:
+                exp.stop()
+
+    return {
+        "config": "gang_obs", "unit": "ranks merged",
+        "value": n_ranks,
+        "n_ranks": n_ranks,
+        "run_id": run_id,
+        "gang_step_skew_s": round(float(xp["step_skew_s"]), 6),
+        "gang_comm_s": round(float(xp["comm_s"]), 6),
+        "gang_comm_fraction": round(float(xp["comm_fraction"]), 4),
+        "merged_series": sum(
+            len(merged_snap.get(s, {}))
+            for s in ("counters", "gauges", "histograms")
+        ),
+        "truncation_trips": 1,
+        "scrape_reconciled": True,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
 
 
 def bench_hogwild_chaos_soak(rounds: int = 4, iters: int = 16,
@@ -1424,6 +1740,14 @@ def bench_moe_lm() -> dict:
                             iters=6, warmup=2, chunks=2, with_trace=True)
     dense = _sync_epoch_bench(spec_for(0), ids[:, :-1], ids[:, 1:], batch,
                               iters=6, warmup=2, chunks=2)
+    # Comm-fraction drift gate: the MoE capture records a comm_budget
+    # every round; once a prior round's record exists, a lost overlap
+    # (dispatch/combine no longer hidden under expert compute) fails
+    # the bench instead of silently shipping.
+    if "comm_fraction" in moe:
+        moe["comm_drift"] = _check_comm_drift(
+            "moe_lm", moe["comm_fraction"], moe.get("overlap_fraction", 0.0)
+        )
     return {
         "config": "moe_lm", "unit": "tokens/sec/chip",
         "n_experts": 8, "seq_len": seq,
@@ -1446,6 +1770,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_chaos": bench_hogwild_chaos,
     "hogwild_chaos_soak": bench_hogwild_chaos_soak,
     "sharded_trace": bench_sharded_trace,
+    "gang_obs": bench_gang_obs,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,

@@ -33,10 +33,8 @@ restarted hogwild workers rejoin by pulling the current server version
 
 from __future__ import annotations
 
-import json
 import threading
 import time
-import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
 from sparktorch_tpu.ft.policy import FtPolicy
@@ -187,19 +185,34 @@ class Supervisor:
 
             return gang_report(self.heartbeat_dir)
         if self.exporter_url:
+            # The scrape must DEGRADE, never crash the poll loop: an
+            # exporter answering 500, a torn JSON body, a server that
+            # vanished mid-poll, or a well-formed reply with a shape
+            # this reader doesn't expect (non-dict, junk rank keys)
+            # all reduce to "no report this tick" — a warning plus the
+            # ft_scrape_errors_total counter, while death-and-restart
+            # supervision from handle liveness continues untouched.
+            from sparktorch_tpu.obs.collector import ScrapeError, scrape_json
+
+            url = self.exporter_url.rstrip("/") + "/heartbeats"
             try:
-                with urllib.request.urlopen(
-                    self.exporter_url.rstrip("/") + "/heartbeats",
-                    timeout=2.0,
-                ) as resp:
-                    report = json.loads(resp.read())
-            except (OSError, ValueError):
+                report = scrape_json(url, timeout=2.0)
+                if not isinstance(report, dict):
+                    raise ScrapeError(f"{url}: not a JSON object")
+                # The exporter serialized rank keys as strings; re-key
+                # (junk keys are a malformed reply, same degradation).
+                report["ranks"] = {
+                    int(k): v for k, v in (report.get("ranks") or {}).items()
+                }
+                return report
+            except (ScrapeError, ValueError, TypeError, AttributeError) as e:
+                self.telemetry.counter("ft_scrape_errors_total",
+                                       labels={"source": "exporter"})
+                self._log.warning(
+                    f"[sparktorch_tpu:ft] exporter scrape failed "
+                    f"(skew/stall policies skip this tick): {e}"
+                )
                 return None
-            # The exporter serialized rank keys as strings; re-key.
-            report["ranks"] = {
-                int(k): v for k, v in report.get("ranks", {}).items()
-            }
-            return report
         return None
 
     # -- policy application ------------------------------------------------

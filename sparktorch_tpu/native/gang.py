@@ -92,6 +92,11 @@ class GangMetricsExporter:
             gauges["gang.coordinator_dead_rank"] = float(coord.dead_rank)
             gauges["gang.coordinator_world_size"] = float(coord.world_size)
             gauges["gang.coordinator_generation"] = float(coord.generation)
+            if getattr(coord, "run_id", None):
+                # The gang run_id rides the scrape like a build_info
+                # string, so a collector can correlate this exporter
+                # with the rank streams without parsing REG lines.
+                snap.setdefault("info", {})["gang.run_id"] = coord.run_id
         return snap
 
     def start(self) -> "GangMetricsExporter":
@@ -177,6 +182,14 @@ def _lib():
     lib.gang_server_start2.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
     ]
+    lib.gang_server_start3.restype = ctypes.c_void_p
+    lib.gang_server_start3.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p,
+    ]
+    lib.gang_server_run_id.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
     lib.gang_server_port.argtypes = [ctypes.c_void_p]
     lib.gang_server_generation.restype = ctypes.c_long
     lib.gang_server_generation.argtypes = [ctypes.c_void_p]
@@ -197,6 +210,15 @@ def _lib():
     lib.gang_client_connect3.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
         ctypes.c_int, ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.gang_client_connect4.restype = ctypes.c_void_p
+    lib.gang_client_connect4.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_long, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.gang_client_run_id.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
     ]
     lib.gang_client_generation.restype = ctypes.c_long
     lib.gang_client_generation.argtypes = [ctypes.c_void_p]
@@ -233,10 +255,33 @@ class GangCoordinator:
 
     def __init__(self, world_size: int, port: int = 0,
                  heartbeat_timeout_ms: int = 10_000,
-                 rejoin_grace_ms: int = 0):
+                 rejoin_grace_ms: int = 0,
+                 run_id: Optional[str] = None):
+        # ``run_id`` (None = untagged, the pre-run-id wire format —
+        # raw-wire peers keep seeing "OK <ws> <gen>"): a gang-unique
+        # id announced in every OK reply; workers stamp it on their
+        # spans/events/heartbeats so a fleet collector can join the
+        # per-rank streams. bringup_multihost mints one by default.
+        # The id travels as ONE token on the space-delimited line
+        # protocol (and sscanf caps it at 127 bytes): an id containing
+        # whitespace would be silently split — the client would learn
+        # a truncated id, claim it on its heartbeat-channel REG, and
+        # be refused ERR run, surfacing as a baffling bring-up
+        # failure. Refuse the malformed id HERE instead.
+        if run_id is not None and (
+                not run_id or len(run_id) > 120
+                or not run_id.isascii() or not run_id.isprintable()
+                or any(c.isspace() for c in run_id)):
+            raise ValueError(
+                f"run_id {run_id!r} is not line-protocol-safe: need a "
+                f"non-empty printable-ASCII token without whitespace, "
+                f"<= 120 chars (obs.mint_run_id() produces one)"
+            )
         self._lib = _lib()
-        self._handle = self._lib.gang_server_start2(
-            port, world_size, heartbeat_timeout_ms, rejoin_grace_ms
+        self.run_id = run_id
+        self._handle = self._lib.gang_server_start3(
+            port, world_size, heartbeat_timeout_ms, rejoin_grace_ms,
+            (run_id or "").encode(),
         )
         if not self._handle:
             raise RuntimeError("gang coordinator failed to start")
@@ -307,18 +352,36 @@ class GangWorker:
         if not self._handle:
             raise GangFailure(f"rank {rank}: cannot register with {host}:{port}")
         self._generation = int(self._lib.gang_client_generation(self._handle))
+        # Run-id correlation: a run-id-tagged coordinator announced
+        # the gang's run_id in its OK reply. Adopt it everywhere this
+        # rank publishes — telemetry events (spans included) and the
+        # attributed heartbeat records — so a fleet collector can join
+        # the per-rank streams into one gang timeline. None when the
+        # coordinator predates the run-id protocol.
+        buf = ctypes.create_string_buffer(256)
+        n = self._lib.gang_client_run_id(self._handle, buf, len(buf))
+        self.run_id: Optional[str] = (
+            buf.value.decode() if n > 0 else None
+        )
+        if self.run_id:
+            if self.heartbeat is not None:
+                self.heartbeat.set_run_id(self.run_id)
+            if telemetry is not None:
+                telemetry.set_run_id(self.run_id)
         # Separate connection for heartbeats: the main connection can
         # be parked inside a blocking barrier read, and interleaving
         # HB traffic on the same socket would steal its GO line. A
         # worker without a working heartbeat channel has no failure
         # detection at all — refuse to construct rather than run blind.
-        # Tagged with the generation the main channel just joined: a
-        # reformed gang must not accept this worker's second REG as a
-        # fresh member.
+        # Tagged with the generation the main channel just joined (and
+        # the run id it learned): a reformed gang must not accept this
+        # worker's second REG as a fresh member, and a recycled
+        # endpoint serving a DIFFERENT run must refuse it.
         status = ctypes.c_int(-1)
-        self._hb_handle = self._lib.gang_client_connect3(
+        self._hb_handle = self._lib.gang_client_connect4(
             host.encode(), port, rank, address.encode(), timeout_ms,
-            self._generation, ctypes.byref(status),
+            self._generation, (self.run_id or "").encode(),
+            ctypes.byref(status),
         )
         if not self._hb_handle:
             self._lib.gang_client_close(self._handle)
@@ -384,10 +447,11 @@ class GangWorker:
                 # rejoin-grace race the generation tags exist to close.
                 host, port, address, timeout_ms = self._endpoint
                 status = ctypes.c_int(-1)
-                fresh = self._lib.gang_client_connect3(
+                fresh = self._lib.gang_client_connect4(
                     host.encode(), port, self.rank,
                     address.encode(), min(timeout_ms, 2000),
-                    self._generation, ctypes.byref(status),
+                    self._generation, (self.run_id or "").encode(),
+                    ctypes.byref(status),
                 ) or None
                 if status.value == 1:
                     self._hb_dead.set()

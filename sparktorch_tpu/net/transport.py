@@ -79,7 +79,7 @@ class BinaryTransport:
                  pull_timeout: float = _PULL_TIMEOUT,
                  retries: int = 3, backoff_s: float = 0.05,
                  deadline_s: Optional[float] = _RECONNECT_DEADLINE,
-                 telemetry=None):
+                 telemetry=None, run_id: Optional[str] = None):
         parts = urlsplit(url if "//" in url else f"http://{url}")
         if parts.scheme not in ("", "http"):
             raise ValueError(f"BinaryTransport speaks http only, got {url!r}")
@@ -102,6 +102,14 @@ class BinaryTransport:
         # None = uncapped (the pre-deadline behavior).
         self.deadline_s = deadline_s
         self.telemetry = telemetry
+        # Run-ID correlation (16-bit tag in the frame header's reserved
+        # bytes): every push this worker sends names its gang run, and
+        # a pulled frame carrying a DIFFERENT nonzero tag — a worker
+        # pointed at another run's server — is counted and warned, not
+        # silently trained on.
+        from sparktorch_tpu.obs.collector import run_tag as _rt
+
+        self.run_tag = _rt(run_id)
         self.stats = _new_phase_stats()
         self._conn: Optional[http.client.HTTPConnection] = None
 
@@ -215,6 +223,15 @@ class BinaryTransport:
             raise TransportError(f"/parameters.bin -> {status}")
         st["pull_fresh"] += 1
         st["pull_bytes"] += len(body)
+        frame_tag = wire.frame_run_tag(body)
+        if frame_tag and self.run_tag and frame_tag != self.run_tag:
+            tele = self.telemetry
+            if tele is None:
+                from sparktorch_tpu.obs import get_telemetry
+
+                tele = self.telemetry = get_telemetry()
+            tele.counter("transport_run_tag_mismatches_total",
+                         labels={"host": self.host, "port": self.port})
         version, tree = wire.decode(body)
         return version, tree
 
@@ -232,7 +249,7 @@ class BinaryTransport:
             leaves, _ = wire.quantize_tree(host, self.quant, self._residuals)
         else:
             leaves = wire.flatten_tree(host)
-        buffers = wire.encode(leaves)
+        buffers = wire.encode(leaves, run_tag=self.run_tag)
         nbytes = wire.frame_nbytes(buffers)
         t1 = time.perf_counter()
         st["push_materialize_s"] += t1 - t0

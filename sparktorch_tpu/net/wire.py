@@ -10,7 +10,13 @@ with a self-describing frame whose payload IS the tensors' memory:
     0       4     magic  b"STWR"
     4       1     wire format version (1)
     5       1     flags (reserved, 0)
-    6       2     reserved
+    6       2     run tag (uint16 LE; 0 = untagged) — the 16-bit
+                  correlation tag of the gang run_id
+                  (:func:`sparktorch_tpu.obs.collector.run_tag`), so
+                  every frame on the wire names the run it belongs to
+                  and a server can flag cross-run traffic. Pre-run-id
+                  encoders wrote 0 here (the field was reserved), so
+                  old frames parse as untagged.
     8       8     snapshot version tag (int64 LE; -1 = untagged)
     16      4     table length in bytes (uint32 LE)
     20      8     payload length in bytes (uint64 LE)
@@ -56,7 +62,7 @@ except ImportError:  # pragma: no cover - jax deps always ship ml_dtypes
 
 MAGIC = b"STWR"
 WIRE_VERSION = 1
-# magic, version, flags, reserved, snapshot version, table len, payload len
+# magic, version, flags, run tag, snapshot version, table len, payload len
 _HEADER = struct.Struct("<4sBBHqIQ")
 HEADER_SIZE = _HEADER.size
 
@@ -275,7 +281,8 @@ def _encode_node(node: Any, table_out: Any, buffers: Buffers,
     return offset + arr.nbytes
 
 
-def encode(tree_or_leaves: Any, version: int = -1) -> Buffers:
+def encode(tree_or_leaves: Any, version: int = -1,
+           run_tag: int = 0) -> Buffers:
     """Frame a tree (or pre-flattened/quantized leaves) for the wire.
 
     Returns ``[header+table bytes, buffer, buffer, ...]`` where each
@@ -302,8 +309,8 @@ def encode(tree_or_leaves: Any, version: int = -1) -> Buffers:
         payload_len = _encode_node(tree, table, buffers, 0)
 
     table_bytes = json.dumps(table, separators=(",", ":")).encode()
-    header = _HEADER.pack(MAGIC, WIRE_VERSION, 0, 0, int(version),
-                          len(table_bytes), payload_len)
+    header = _HEADER.pack(MAGIC, WIRE_VERSION, 0, int(run_tag) & 0xFFFF,
+                          int(version), len(table_bytes), payload_len)
     return [header + table_bytes, *buffers]
 
 
@@ -316,6 +323,19 @@ def frame_bytes(buffers: Buffers) -> bytes:
     """Join the frame into one contiguous body (the single copy that a
     cache or a non-scatter writer pays)."""
     return b"".join(buffers)
+
+
+def frame_run_tag(data: Union[bytes, bytearray, memoryview]) -> int:
+    """The 16-bit run tag from a frame header (0 = untagged) without
+    decoding the body — the cheap cross-run correlation check a server
+    runs per request. Raises :class:`WireError` on a non-frame."""
+    mv = memoryview(data)
+    if len(mv) < HEADER_SIZE:
+        raise WireError(f"frame truncated: {len(mv)} < header {HEADER_SIZE}")
+    magic, wire_ver, _flags, tag, _v, _t, _p = _HEADER.unpack_from(mv, 0)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    return int(tag)
 
 
 def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:

@@ -29,10 +29,20 @@ from sparktorch_tpu.obs.heartbeat import (
 )
 from sparktorch_tpu.obs.log import get_logger
 from sparktorch_tpu.obs.xprof import (
+    GangAnalysis,
     TraceAnalysis,
     TraceParseError,
     analyze_and_publish,
     analyze_trace,
+    merge_analyses,
+)
+from sparktorch_tpu.obs.collector import (
+    FleetCollector,
+    ScrapeError,
+    mint_run_id,
+    run_tag,
+    scrape_json,
+    scrape_text,
 )
 
 __all__ = [
@@ -52,8 +62,16 @@ __all__ = [
     "gang_report",
     "read_heartbeats",
     "get_logger",
+    "GangAnalysis",
     "TraceAnalysis",
     "TraceParseError",
     "analyze_and_publish",
     "analyze_trace",
+    "merge_analyses",
+    "FleetCollector",
+    "ScrapeError",
+    "mint_run_id",
+    "run_tag",
+    "scrape_json",
+    "scrape_text",
 ]

@@ -1,0 +1,490 @@
+"""Fleet collector: the gang-level aggregation layer over per-rank
+exporters.
+
+Every rank already serves its own observability surface — the param
+server and :class:`~sparktorch_tpu.native.gang.GangMetricsExporter`
+both expose ``/metrics`` (Prometheus text), ``/telemetry`` (the full
+snapshot as JSON, including named SECTIONS like the last published
+xprof analysis), and ``/heartbeats`` — but a multi-host run is N of
+those, one per host, and nothing assembled a whole-gang view (the
+ROADMAP's "multi-host half of the Dapper gap"). The
+:class:`FleetCollector` closes it:
+
+- **scrape**: periodically pull every rank's ``/telemetry`` and
+  ``/heartbeats``; a failing rank degrades to a warning + counter
+  (``collector.scrape_errors_total{rank}``), never a dead poll loop —
+  its last good snapshot keeps serving, aging visibly.
+- **tag**: every scraped metric series is re-keyed with ``rank`` and
+  ``host`` labels (existing labels win on conflict — a heartbeat
+  gauge's own ``rank`` label already names the right rank), so the
+  merged view never aliases two ranks' series.
+- **merge**: per-rank ``xprof`` snapshot sections fold into one gang
+  budget via :func:`sparktorch_tpu.obs.xprof.merge_analyses`
+  (families summed, step walls max'd, cross-rank skew) and publish
+  onto the collector's own bus under ``xprof.gang_*``; heartbeat
+  tables union into one gang table.
+- **re-serve**: ``GET /gang`` (the joined gang document: rank scrape
+  status, merged heartbeats, merged xprof budget, per-rank run_ids),
+  ``GET /metrics`` (Prometheus text of the merged view), and
+  ``GET /telemetry`` (the merged snapshot as JSON) — plus an optional
+  JSONL sink appending one merged snapshot per poll, which
+  ``python -m sparktorch_tpu.obs.timeline --gang`` renders.
+
+Run-ID correlation: a gang-unique ``run_id`` (:func:`mint_run_id`) is
+minted at bring-up, announced by the gang coordinator's OK reply,
+stamped on every span/event/heartbeat, and carried as a 16-bit tag
+(:func:`run_tag`) in the binary wire header's reserved bytes — the
+collector joins per-rank streams on it.
+
+This module also owns the ONLY sanctioned exporter-scraping helpers
+(:func:`scrape_json` / :func:`scrape_text`): ``make lint-obs`` bans
+ad-hoc ``urllib`` scraping of exporter routes outside ``obs/`` so
+every reader shares the same timeout/error/telemetry discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+import zlib
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+from urllib.parse import urlsplit
+
+from sparktorch_tpu.obs.log import get_logger
+from sparktorch_tpu.obs.prom import _parse_flat_key  # shared key grammar
+from sparktorch_tpu.obs.telemetry import Telemetry, format_key
+
+_LOG = get_logger("sparktorch_tpu.obs.collector")
+
+_SCRAPE_TIMEOUT = 2.0
+
+
+# ---------------------------------------------------------------------------
+# Run-ID minting + wire tag
+# ---------------------------------------------------------------------------
+
+
+def mint_run_id(prefix: str = "gang") -> str:
+    """A gang-unique run id: sortable timestamp + random suffix, no
+    protocol-reserved characters (spaces, commas, '=' — it travels on
+    the gang REG line and as a metric-adjacent token)."""
+    return f"{prefix}-{time.strftime('%Y%m%dT%H%M%S')}-{os.urandom(3).hex()}"
+
+
+def run_tag(run_id: Optional[str]) -> int:
+    """16-bit correlation tag for the binary wire header's reserved
+    bytes (frames predate string payloads there; two bytes is room for
+    a join key, not a name). 0 is reserved for "untagged" — the value
+    every pre-tag encoder wrote — so a real run id always maps to a
+    nonzero tag."""
+    if not run_id:
+        return 0
+    tag = zlib.crc32(str(run_id).encode()) & 0xFFFF
+    return tag or 1
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned scrape helpers
+# ---------------------------------------------------------------------------
+
+
+class ScrapeError(OSError):
+    """The exporter was unreachable or answered garbage."""
+
+
+def scrape_text(url: str, timeout: float = _SCRAPE_TIMEOUT) -> str:
+    """GET a text route (e.g. ``/metrics``). Raises ScrapeError on any
+    network failure or non-200 status."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            if resp.status != 200:
+                raise ScrapeError(f"{url}: HTTP {resp.status}")
+            return resp.read().decode("utf-8", errors="replace")
+    except ScrapeError:
+        raise
+    except (OSError, ValueError) as e:
+        raise ScrapeError(f"{url}: {type(e).__name__}: {e}") from e
+
+
+def scrape_json(url: str, timeout: float = _SCRAPE_TIMEOUT) -> Any:
+    """GET + parse a JSON route (``/telemetry``, ``/heartbeats``,
+    ``/gang``). Raises ScrapeError on network failure, non-200, or a
+    body that is not valid JSON (the torn-response case readers must
+    survive)."""
+    body = scrape_text(url, timeout=timeout)
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise ScrapeError(f"{url}: torn/invalid JSON: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# The collector
+# ---------------------------------------------------------------------------
+
+
+class _RankState:
+    __slots__ = ("url", "host", "snapshot", "heartbeats", "last_ok_ts",
+                 "last_error", "scrapes", "errors")
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.host = urlsplit(self.url).hostname or "?"
+        self.snapshot: Optional[Dict[str, Any]] = None
+        self.heartbeats: Optional[Dict[str, Any]] = None
+        self.last_ok_ts: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self.scrapes = 0
+        self.errors = 0
+
+
+def _tag_series(flat: str, rank: str, host: str) -> str:
+    """Re-key ``name{labels}`` with rank/host labels. Labels the
+    series already carries WIN (a heartbeat gauge's own ``rank`` names
+    the heartbeat's rank, not the scrape target's)."""
+    name, labels = _parse_flat_key(flat)
+    merged = {"rank": rank, "host": host}
+    merged.update(labels)
+    return format_key((name, tuple(sorted(merged.items()))))
+
+
+class FleetCollector:
+    """Scrape N rank exporters, merge, re-serve the unified view.
+
+    ``targets`` maps rank -> exporter base URL (the
+    ``GangMetricsExporter`` / ``ParamServerHttp`` address). ``poll()``
+    is one synchronous sweep — callable directly (tests, one-shot CLI
+    use) or driven by the background loop ``start()`` launches when
+    ``poll_interval_s`` > 0. ``jsonl_path`` appends one merged
+    snapshot per poll (the ``timeline --gang`` input).
+    """
+
+    def __init__(self, targets: Mapping[Any, str],
+                 telemetry: Optional[Telemetry] = None,
+                 run_id: Optional[str] = None,
+                 poll_interval_s: float = 2.0,
+                 jsonl_path: Optional[str] = None,
+                 scrape_timeout_s: float = _SCRAPE_TIMEOUT,
+                 host: str = "127.0.0.1", port: int = 0):
+        if not targets:
+            raise ValueError("FleetCollector needs at least one target")
+        self.run_id = run_id or mint_run_id("collector")
+        self.telemetry = telemetry or Telemetry(run_id=self.run_id)
+        self._ranks: Dict[str, _RankState] = {
+            str(r): _RankState(url) for r, url in targets.items()
+        }
+        self.poll_interval_s = poll_interval_s
+        self.jsonl_path = jsonl_path
+        self.scrape_timeout_s = scrape_timeout_s
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self._gang_xprof: Optional[Dict[str, Any]] = None
+        self._xprof_fingerprint: Optional[Tuple] = None
+        self._httpd = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+
+    # -- scraping ----------------------------------------------------------
+
+    def poll(self) -> Dict[str, Any]:
+        """One sweep over every rank: scrape, tag, merge, sink.
+        Returns the merged snapshot. Per-rank failures degrade to
+        warnings + counters; the sweep itself never raises."""
+        tele = self.telemetry
+        for rank, st in self._ranks.items():
+            labels = {"rank": rank}
+            try:
+                snap = scrape_json(st.url + "/telemetry",
+                                   timeout=self.scrape_timeout_s)
+                if not isinstance(snap, dict):
+                    raise ScrapeError(f"{st.url}/telemetry: not an object")
+                hb: Optional[Dict[str, Any]] = None
+                try:
+                    got = scrape_json(st.url + "/heartbeats",
+                                      timeout=self.scrape_timeout_s)
+                    hb = got if isinstance(got, dict) else None
+                except ScrapeError:
+                    hb = None  # optional route; /telemetry carries gauges
+                with self._lock:
+                    st.snapshot = snap
+                    if hb is not None:
+                        # Same degrade-to-last-good contract as the
+                        # snapshot: a transient /heartbeats failure
+                        # must not make this target's ranks VANISH
+                        # from /gang — the stale table keeps serving
+                        # (its ages grow, which is the visible signal).
+                        st.heartbeats = hb
+                    st.last_ok_ts = time.time()
+                    st.last_error = None
+                    st.scrapes += 1
+                tele.counter("collector.scrapes_total", labels=labels)
+            except ScrapeError as e:
+                with self._lock:
+                    st.errors += 1
+                    st.last_error = str(e)
+                tele.counter("collector.scrape_errors_total", labels=labels)
+                _LOG.warning(
+                    f"[sparktorch_tpu:collector] rank {rank} scrape "
+                    f"failed (serving last good snapshot): {e}"
+                )
+        self._merge_xprof()
+        merged = self.merged_snapshot()
+        if self.jsonl_path:
+            from sparktorch_tpu.obs.sinks import write_jsonl
+
+            try:
+                write_jsonl(self.jsonl_path,
+                            [{"kind": "gang_snapshot", **merged}],
+                            append=True)
+            except OSError as e:
+                _LOG.warning(
+                    f"[sparktorch_tpu:collector] JSONL sink "
+                    f"{self.jsonl_path!r} failed: {e}"
+                )
+        return merged
+
+    def _merge_xprof(self) -> None:
+        """Fold every rank's ``xprof`` snapshot section into one gang
+        budget. Re-published only when some rank's analysis actually
+        changed — republishing identical analyses each poll would
+        duplicate histogram samples and inflate the merge counters."""
+        with self._lock:
+            found: List[Tuple[str, Dict[str, Any]]] = []
+            for rank, st in self._ranks.items():
+                section = ((st.snapshot or {}).get("sections") or {}).get(
+                    "xprof")
+                if isinstance(section, dict) and section.get("steps"):
+                    found.append((rank, section))
+        if not found:
+            return
+        fingerprint = tuple(
+            (rank, d.get("source"), d.get("n_events"), d.get("wall_s"))
+            for rank, d in found
+        )
+        if fingerprint == self._xprof_fingerprint:
+            return
+        from sparktorch_tpu.obs.xprof import merge_analyses
+
+        try:
+            gang = merge_analyses([d for _, d in found],
+                                  ranks=[r for r, _ in found],
+                                  run_id=self.run_id)
+        except (KeyError, TypeError, ValueError) as e:
+            _LOG.warning(
+                f"[sparktorch_tpu:collector] xprof merge failed: {e}"
+            )
+            return
+        self._xprof_fingerprint = fingerprint
+        gang.publish(self.telemetry)
+        with self._lock:
+            self._gang_xprof = gang.to_dict()
+
+    # -- merged views ------------------------------------------------------
+
+    def _rank_status_locked(self, now: float) -> Dict[str, Any]:
+        """Per-rank scrape status; caller holds ``self._lock``."""
+        return {
+            r: {
+                "url": st.url,
+                "host": st.host,
+                "ok": st.last_error is None and st.snapshot is not None,
+                "scrapes": st.scrapes,
+                "errors": st.errors,
+                "last_error": st.last_error,
+                "last_scrape_age_s": (
+                    now - st.last_ok_ts
+                    if st.last_ok_ts is not None else None
+                ),
+                "run_id": (st.snapshot or {}).get("run_id"),
+            }
+            for r, st in self._ranks.items()
+        }
+
+    def merged_snapshot(self) -> Dict[str, Any]:
+        """The unified metric view: every rank's series re-keyed with
+        rank/host labels, the collector's own metrics (scrape counters,
+        gang xprof budget) alongside, plus per-rank scrape status."""
+        own = self.telemetry.snapshot()
+        now = time.time()
+        with self._lock:
+            rank_snaps = {r: (st.snapshot, st.host)
+                          for r, st in self._ranks.items()}
+            status = self._rank_status_locked(now)
+        merged: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "ts": now,
+            "counters": dict(own.get("counters", {})),
+            "gauges": dict(own.get("gauges", {})),
+            "histograms": dict(own.get("histograms", {})),
+            "spans": dict(own.get("spans", {})),
+            "info": dict(own.get("info", {})),
+            "ranks": status,
+        }
+        if "sections" in own:
+            merged["sections"] = own["sections"]
+        for r, (snap, host) in rank_snaps.items():
+            if not snap:
+                continue
+            for section in ("counters", "gauges", "histograms", "spans",
+                            "info"):
+                for flat, value in (snap.get(section) or {}).items():
+                    merged[section][_tag_series(flat, r, host)] = value
+        merged["gauges"]["collector.ranks"] = float(len(self._ranks))
+        merged["gauges"]["collector.ranks_ok"] = float(
+            sum(1 for s in status.values() if s["ok"])
+        )
+        return merged
+
+    def gang_view(self) -> Dict[str, Any]:
+        """The joined gang document ``GET /gang`` serves: scrape
+        status per rank, the unioned heartbeat table (re-aged at read
+        time), the merged xprof budget, and every run_id seen — the
+        cross-rank correlation surface. Reads only the per-rank status
+        and heartbeat/xprof state — it does NOT pay the full series
+        tag-and-merge that ``merged_snapshot`` does (O(ranks), not
+        O(total series), per ``/gang`` poll)."""
+        now = time.time()
+        hb_ranks: Dict[str, Any] = {}
+        steps: List[int] = []
+        with self._lock:
+            status = self._rank_status_locked(now)
+            gang_xprof = self._gang_xprof
+            for r, st in self._ranks.items():
+                for hb_rank, rec in ((st.heartbeats or {}).get("ranks")
+                                     or {}).items():
+                    prev = hb_ranks.get(str(hb_rank))
+                    # Two targets may report the same heartbeat rank
+                    # (shared directory): freshest record wins.
+                    if prev is not None and (
+                            prev.get("last_seen_age_s", 1e18)
+                            <= rec.get("last_seen_age_s", 1e18)):
+                        continue
+                    hb_ranks[str(hb_rank)] = dict(rec)
+        for rec in hb_ranks.values():
+            if rec.get("step") is not None:
+                steps.append(int(rec["step"]))
+        heartbeats: Dict[str, Any] = {
+            "n_ranks": len(hb_ranks),
+            "ranks": hb_ranks,
+            "alive": sorted((r for r, v in hb_ranks.items()
+                             if v.get("alive")), key=str),
+        }
+        if steps:
+            heartbeats["step_min"] = min(steps)
+            heartbeats["step_max"] = max(steps)
+            heartbeats["step_skew"] = max(steps) - min(steps)
+        return {
+            "run_id": self.run_id,
+            "ts": now,
+            "ranks": status,
+            "run_ids": {r: s.get("run_id") for r, s in status.items()},
+            "heartbeats": heartbeats,
+            "xprof": gang_xprof,
+        }
+
+    # -- HTTP surface ------------------------------------------------------
+
+    def start(self, serve: bool = True,
+              poll_loop: bool = True) -> "FleetCollector":
+        """Start the HTTP surface (``/gang``, ``/metrics``,
+        ``/telemetry``) and — when ``poll_interval_s`` > 0 and
+        ``poll_loop`` — the background scrape loop."""
+        if serve and self._httpd is None:
+            from http.server import (
+                BaseHTTPRequestHandler,
+                ThreadingHTTPServer,
+            )
+
+            from sparktorch_tpu.obs.prom import (
+                CONTENT_TYPE as PROM_CONTENT_TYPE,
+                render_prometheus,
+            )
+
+            collector = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def log_message(self, *a):
+                    pass
+
+                def _send(self, code: int, body: bytes = b"",
+                          content_type: Optional[str] = None):
+                    self.send_response(code)
+                    if content_type:
+                        self.send_header("Content-Type", content_type)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    if body:
+                        self.wfile.write(body)
+
+                def do_GET(self):
+                    route = self.path.split("?", 1)[0]
+                    if route == "/":
+                        self._send(200, b"sparktorch-tpu fleet collector")
+                    elif route == "/gang":
+                        self._send(200,
+                                   json.dumps(collector.gang_view()).encode(),
+                                   content_type="application/json")
+                    elif route == "/metrics":
+                        text = render_prometheus(collector.merged_snapshot())
+                        self._send(200, text.encode(),
+                                   content_type=PROM_CONTENT_TYPE)
+                    elif route == "/telemetry":
+                        self._send(
+                            200,
+                            json.dumps(collector.merged_snapshot()).encode(),
+                            content_type="application/json")
+                    else:
+                        self._send(404)
+
+            self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                              Handler)
+            self.port = self._httpd.server_address[1]
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True
+            )
+            self._http_thread.start()
+        if poll_loop and self.poll_interval_s > 0 \
+                and self._poll_thread is None:
+            self._poll_stop.clear()
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="fleet-collector-poll",
+            )
+            self._poll_thread.start()
+        return self
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.is_set():
+            try:
+                self.poll()
+            except Exception as e:  # the loop must outlive any sweep
+                _LOG.warning(
+                    f"[sparktorch_tpu:collector] poll sweep failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+            self._poll_stop.wait(self.poll_interval_s)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+            self._poll_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -39,7 +39,8 @@ class HeartbeatEmitter:
     ``/metrics`` when a server scope is wired."""
 
     def __init__(self, directory: str, rank: int,
-                 host: Optional[str] = None, telemetry=None):
+                 host: Optional[str] = None, telemetry=None,
+                 run_id: Optional[str] = None):
         self.directory = directory
         self.rank = int(rank)
         self.host = host or socket.gethostname()
@@ -47,8 +48,17 @@ class HeartbeatEmitter:
         self._telemetry = telemetry
         self._beats = 0
         self._step: Optional[int] = None
+        # Gang run correlation: when the gang coordinator minted a
+        # run_id at bring-up, every heartbeat record carries it, so a
+        # collector can join this rank's liveness stream with its
+        # telemetry/trace streams. Mutable via set_run_id (the worker
+        # learns the id only after registration).
+        self.run_id = run_id
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"{_PREFIX}{self.rank}.json")
+
+    def set_run_id(self, run_id: Optional[str]) -> None:
+        self.run_id = run_id
 
     def notify_step(self, step: int) -> None:
         """Record training progress; published on the next (and this)
@@ -75,6 +85,8 @@ class HeartbeatEmitter:
             "beats": self._beats,
             "ts": time.time(),
         }
+        if self.run_id is not None:
+            record["run_id"] = self.run_id
         # Atomic publish: readers never see a torn heartbeat. The temp
         # file lives in the same directory so the rename cannot cross
         # filesystems.
@@ -152,6 +164,7 @@ def gang_report(directory: str,
             "alive": bool(rec.get("alive", False)),
             "beats": rec.get("beats", 0),
             "last_seen_age_s": age,
+            "run_id": rec.get("run_id"),
         }
         if rec.get("step") is not None:
             steps.append(int(rec["step"]))

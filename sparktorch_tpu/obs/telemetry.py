@@ -145,8 +145,16 @@ class Telemetry:
         self._hists: Dict[MetricKey, _Hist] = {}
         self._spans: Dict[MetricKey, _Hist] = {}
         self._info: Dict[MetricKey, str] = {}
+        self._sections: Dict[str, Any] = {}
         self._sinks: List[Callable[[Dict[str, Any]], None]] = []
         self._tls = threading.local()
+
+    def set_run_id(self, run_id: str) -> None:
+        """Adopt a (typically gang-minted) run id mid-scope: every
+        event emitted from here on — spans included — carries it, so
+        per-rank streams sharing one gang run_id can be joined by a
+        collector. Metric state is unaffected."""
+        self.run_id = str(run_id)
 
     # -- recording ---------------------------------------------------------
 
@@ -182,6 +190,24 @@ class Telemetry:
                    labels: Optional[Dict[str, Any]] = None) -> Optional[str]:
         with self._lock:
             return self._info.get(_key(name, labels))
+
+    def set_section(self, name: str, payload: Any) -> None:
+        """Attach a named JSON-serializable SECTION to snapshots (the
+        last published xprof analysis, a gang budget). Sections ride
+        ``snapshot()["sections"]`` — so ``/telemetry`` scrapes and
+        JSONL dumps carry structured documents the flat metric dicts
+        cannot (a fleet collector merges them cross-rank) — and are
+        ignored by the Prometheus renderer. Last write wins; ``None``
+        removes the section."""
+        with self._lock:
+            if payload is None:
+                self._sections.pop(name, None)
+            else:
+                self._sections[name] = payload
+
+    def get_section(self, name: str) -> Optional[Any]:
+        with self._lock:
+            return self._sections.get(name)
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, Any]] = None) -> None:
@@ -287,7 +313,7 @@ class Telemetry:
         Prometheus renderer consumes — one source of truth, so the
         ``/metrics`` route can never disagree with the JSONL sink."""
         with self._lock:
-            return {
+            snap = {
                 "run_id": self.run_id,
                 "ts": time.time(),
                 "counters": {format_key(k): v
@@ -301,6 +327,9 @@ class Telemetry:
                 "info": {format_key(k): v
                          for k, v in sorted(self._info.items())},
             }
+            if self._sections:
+                snap["sections"] = dict(self._sections)
+            return snap
 
     def dump(self, path: str, append: bool = True) -> Dict[str, Any]:
         """Write the snapshot as one JSONL line (the CLI dump format);
@@ -318,6 +347,7 @@ class Telemetry:
             self._hists.clear()
             self._spans.clear()
             self._info.clear()
+            self._sections.clear()
 
     # -- pickling ----------------------------------------------------------
     # A bus rides inside objects that get dill-dumped (a fitted model
@@ -337,11 +367,13 @@ class Telemetry:
                 "_hists": dict(self._hists),
                 "_spans": dict(self._spans),
                 "_info": dict(self._info),
+                "_sections": dict(self._sections),
             }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
         self.__dict__.setdefault("_info", {})  # pre-info pickles
+        self.__dict__.setdefault("_sections", {})  # pre-section pickles
         self._lock = threading.Lock()
         self._sinks = []
         self._tls = threading.local()

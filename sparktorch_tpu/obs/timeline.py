@@ -9,6 +9,14 @@ can read in a terminal, no TensorBoard required.
     python -m sparktorch_tpu.obs.timeline run_telemetry.jsonl
     python -m sparktorch_tpu.obs.timeline trace.json.gz --json
 
+``--gang`` renders the WHOLE-GANG view (per-rank lanes + cross-rank
+skew annotations) from N per-host traces merged on the spot, or from
+a fleet collector's JSONL sink / ``/gang`` document that already
+carries the merged budget:
+
+    python -m sparktorch_tpu.obs.timeline --gang host0_trace host1_trace
+    python -m sparktorch_tpu.obs.timeline --gang collector_sink.jsonl
+
 Rendering is pure string-building (testable offline); only the CLI
 entry prints.
 """
@@ -20,9 +28,11 @@ import json
 from typing import Any, Dict, List, Optional
 
 from sparktorch_tpu.obs.xprof import (
+    GangAnalysis,
     TraceAnalysis,
     TraceParseError,
     analyze_trace,
+    merge_analyses,
 )
 
 _BAR_W = 40
@@ -108,6 +118,92 @@ def render_report(analysis: TraceAnalysis, top: int = 10) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Gang rendering (per-rank lanes, skew annotations)
+# ---------------------------------------------------------------------------
+
+
+def render_gang_report(gang: Any) -> str:
+    """Whole-gang timeline from a :class:`GangAnalysis` (or its
+    ``to_dict()`` form — what a collector's ``/gang`` route or JSONL
+    sink carries): one line per step with the gang wall (the slowest
+    rank's pace) and the cross-rank skew annotation, then one LANE per
+    rank showing where that rank's copy of the step went."""
+    d = gang.to_dict() if isinstance(gang, GangAnalysis) else dict(gang)
+    lines = [
+        f"gang: {d.get('n_ranks', '?')} ranks"
+        + (f"   run: {d['run_id']}" if d.get("run_id") else ""),
+        f"steps: {d.get('n_steps', len(d.get('steps', [])))}"
+        f"   worst step skew: {_fmt_ms(d.get('step_skew_s', 0.0))}",
+        "",
+        f"{'step':>6} {'gang wall':>10} {'skew':>10} {'comm':>10}"
+        f" {'comm%':>7} {'ovl%':>6}"
+        f"  [walls max'd across ranks; seconds summed]",
+    ]
+    for s in d.get("steps", []):
+        step = "-" if s.get("step") is None else str(s["step"])
+        lines.append(
+            f"{step:>6} {_fmt_ms(s['wall_s']):>10}"
+            f" {_fmt_ms(s.get('skew_s', 0.0)):>10}"
+            f" {_fmt_ms(s['comm_s']):>10}"
+            f" {100 * s.get('comm_fraction', 0.0):>6.1f}"
+            f" {100 * s.get('overlap_fraction', 0.0):>5.1f}"
+        )
+        ranks = s.get("ranks") or {}
+        walls = [lane.get("wall_s", 0.0) for lane in ranks.values()]
+        slowest = max(walls) if walls else 0.0
+
+        def _rank_key(item):
+            try:
+                return (0, int(item[0]))
+            except ValueError:
+                return (1, item[0])
+
+        for rank, lane in sorted(ranks.items(), key=_rank_key):
+            bar = _budget_bar(lane.get("window_s", 0.0),
+                              lane.get("compute_s", 0.0),
+                              lane.get("comm_s", 0.0),
+                              lane.get("overlap_s", 0.0))
+            straggler = (" <- straggler"
+                         if walls and lane.get("wall_s", 0.0) == slowest
+                         and s.get("skew_s", 0.0) > 0 else "")
+            lines.append(
+                f"{'':>6}   rank {rank:<4} {_fmt_ms(lane.get('wall_s', 0.0)):>10}"
+                f"  {bar}{straggler}"
+            )
+    lines += [
+        "",
+        f"gang budget: wall {_fmt_ms(d.get('wall_s', 0.0))} | compute "
+        f"{_fmt_ms(d.get('compute_s', 0.0))} | comm "
+        f"{_fmt_ms(d.get('comm_s', 0.0))} "
+        f"({100 * d.get('comm_fraction', 0.0):.1f}% of gang device-time, "
+        f"{100 * d.get('overlap_fraction', 0.0):.1f}% hidden under compute)",
+    ]
+    fams = d.get("collective_s") or {}
+    if fams:
+        lines.append("collectives (summed across ranks):")
+        counts = d.get("collective_counts") or {}
+        for fam, sec in sorted(fams.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {fam:<16} {_fmt_ms(sec):>10}"
+                         f"  x{counts.get(fam, 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def _gang_from_jsonl(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The last merged gang budget in a collector sink (or a dumped
+    collector snapshot): ``sections.xprof_gang`` on snapshot-shaped
+    records, ``xprof`` on ``/gang``-document records."""
+    for rec in reversed(records):
+        section = (rec.get("sections") or {}).get("xprof_gang")
+        if isinstance(section, dict) and section.get("steps"):
+            return section
+        xprof = rec.get("xprof")
+        if isinstance(xprof, dict) and xprof.get("kind") == "gang" \
+                and xprof.get("steps"):
+            return xprof
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Snapshot (JSONL dump) rendering
 # ---------------------------------------------------------------------------
 
@@ -182,10 +278,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sparktorch_tpu.obs.timeline",
         description="Per-step timeline and comm/compute budget from an "
-                    "XLA trace capture or a telemetry JSONL dump.",
+                    "XLA trace capture or a telemetry JSONL dump; "
+                    "--gang merges N per-host traces (or reads a fleet "
+                    "collector sink) into one whole-gang view.",
     )
-    parser.add_argument("path", help="trace.json(.gz), a profile log "
-                                     "dir, or a telemetry .jsonl dump")
+    parser.add_argument("paths", nargs="+", metavar="path",
+                        help="trace.json(.gz), a profile log dir, or a "
+                             "telemetry/collector .jsonl; --gang "
+                             "accepts several traces (one per host)")
+    parser.add_argument("--gang", action="store_true",
+                        help="render the whole-gang view: per-rank "
+                             "lanes, cross-rank skew annotations")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis dict as JSON")
     parser.add_argument("--top", type=int, default=10,
@@ -193,6 +296,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--step-name", default="train_step",
                         help="step annotation event name")
     args = parser.parse_args(argv)
+    args.path = args.paths[0]
+
+    if args.gang:
+        return _main_gang(args)
+    if len(args.paths) > 1:
+        print("error: multiple paths need --gang (per-host traces "
+              "merge into one gang view)")
+        return 2
 
     if _looks_like_jsonl(args.path):
         from sparktorch_tpu.obs.sinks import read_jsonl
@@ -220,6 +331,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(analysis.to_dict()))
     else:
         print(render_report(analysis, top=args.top), end="")
+    return 0
+
+
+def _main_gang(args) -> int:
+    """--gang: one collector JSONL (already-merged budget) or N
+    per-host traces merged here."""
+    if len(args.paths) == 1 and _looks_like_jsonl(args.paths[0]):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(args.paths[0])
+        except OSError as e:
+            print(f"error: {e}")
+            return 1
+        gang = _gang_from_jsonl(records)
+        if gang is None:
+            print(f"no merged gang budget (sections.xprof_gang) in "
+                  f"{args.paths[0]}")
+            return 1
+    else:
+        analyses = []
+        for p in args.paths:
+            try:
+                analyses.append(analyze_trace(p, step_name=args.step_name))
+            except TraceParseError as e:
+                print(f"error: {e}")
+                return 1
+        gang = merge_analyses(analyses).to_dict()
+    print(json.dumps(gang) if args.json
+          else render_gang_report(gang), end="" if not args.json else "\n")
     return 0
 
 

@@ -361,6 +361,281 @@ class TraceAnalysis:
             collective_s=self.family_s(),
             top_ops=self.top_ops[:5],
         )
+        # The full analysis also rides the snapshot as a SECTION: a
+        # /telemetry scrape then carries the per-step attribution a
+        # fleet collector needs to fold N ranks into one gang budget
+        # (merge_analyses) — rolled-up metrics alone cannot be merged
+        # (max'd step walls and cross-rank skew need per-step data).
+        tele.set_section("xprof", self.to_dict())
+
+
+# ---------------------------------------------------------------------------
+# Cross-host (gang) merge
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GangStepAttribution:
+    """One training step across the whole gang.
+
+    Walls are MAX'd across ranks (the gang proceeds at the slowest
+    rank's pace); device-seconds (compute/comm/overlap, per-family)
+    are SUMMED (total chip-time the gang spent); ``skew_s`` is the
+    spread between the slowest and fastest rank's step wall — the
+    straggler signal at trace resolution, always >= 0."""
+
+    step: Optional[int]
+    wall_s: float                # max over ranks
+    window_s: float              # max over ranks
+    compute_s: float             # sum over ranks
+    comm_s: float                # sum over ranks
+    overlap_s: float             # sum over ranks
+    skew_s: float                # max(wall) - min(wall) over ranks
+    n_ranks: int                 # ranks contributing to this step
+    families: Dict[str, float]   # summed per collective family
+    counts: Dict[str, int]       # summed event counts per family
+    ranks: Dict[str, Dict[str, float]]  # per-rank lane detail
+
+    @property
+    def comm_fraction(self) -> float:
+        # Fraction of the gang's total device-time budget for this
+        # step (n_ranks concurrent windows) spent with a collective in
+        # flight somewhere.
+        denom = self.n_ranks * self.window_s
+        return self.comm_s / denom if denom > 0 else 0.0
+
+    @property
+    def overlap_fraction(self) -> float:
+        return self.overlap_s / self.comm_s if self.comm_s > 0 else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "step": self.step,
+            "wall_s": self.wall_s,
+            "window_s": self.window_s,
+            "compute_s": self.compute_s,
+            "comm_s": self.comm_s,
+            "overlap_s": self.overlap_s,
+            "skew_s": self.skew_s,
+            "n_ranks": self.n_ranks,
+            "comm_fraction": self.comm_fraction,
+            "overlap_fraction": self.overlap_fraction,
+            "families": dict(self.families),
+            "counts": dict(self.counts),
+            "ranks": {r: dict(v) for r, v in self.ranks.items()},
+        }
+
+
+@dataclasses.dataclass
+class GangAnalysis:
+    """N per-host :class:`TraceAnalysis` folded into one gang budget
+    (the multi-host half of the Dapper gap: per-rank traces exist, this
+    is the whole-gang view). Same ``publish()`` contract as the
+    per-rank analysis, so gang numbers ride the existing
+    bus/scrape/dump plumbing under ``xprof.gang_*`` names."""
+
+    sources: List[str]
+    n_ranks: int
+    steps: List[GangStepAttribution]
+    run_id: Optional[str] = None
+
+    # -- aggregates (gang semantics: walls max'd, seconds summed) ----------
+
+    @property
+    def wall_s(self) -> float:
+        return sum(s.wall_s for s in self.steps)
+
+    @property
+    def comm_s(self) -> float:
+        return sum(s.comm_s for s in self.steps)
+
+    @property
+    def compute_s(self) -> float:
+        return sum(s.compute_s for s in self.steps)
+
+    @property
+    def overlap_s(self) -> float:
+        return sum(s.overlap_s for s in self.steps)
+
+    @property
+    def step_skew_s(self) -> float:
+        """Worst cross-rank step-wall spread in the capture (>= 0)."""
+        return max((s.skew_s for s in self.steps), default=0.0)
+
+    @property
+    def comm_fraction(self) -> float:
+        # Recomputed over the union of every rank's attribution
+        # windows: total collective device-seconds over total
+        # device-seconds of window across the gang.
+        denom = sum(s.n_ranks * s.window_s for s in self.steps)
+        return self.comm_s / denom if denom > 0 else 0.0
+
+    @property
+    def overlap_fraction(self) -> float:
+        return self.overlap_s / self.comm_s if self.comm_s > 0 else 0.0
+
+    def family_s(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for s in self.steps:
+            for fam, sec in s.families.items():
+                out[fam] = out.get(fam, 0.0) + sec
+        return {f: v for f, v in out.items() if v > 0}
+
+    def family_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self.steps:
+            for fam, n in s.counts.items():
+                out[fam] = out.get(fam, 0) + n
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": "gang",
+            "run_id": self.run_id,
+            "sources": list(self.sources),
+            "n_ranks": self.n_ranks,
+            "n_steps": len(self.steps),
+            "wall_s": self.wall_s,
+            "comm_s": self.comm_s,
+            "compute_s": self.compute_s,
+            "overlap_s": self.overlap_s,
+            "step_skew_s": self.step_skew_s,
+            "comm_fraction": self.comm_fraction,
+            "overlap_fraction": self.overlap_fraction,
+            "collective_s": self.family_s(),
+            "collective_counts": self.family_counts(),
+            "steps": [s.to_dict() for s in self.steps],
+        }
+
+    def publish(self, telemetry=None) -> None:
+        """Same contract as :meth:`TraceAnalysis.publish`, under
+        ``xprof.gang_*`` names so gang and per-rank budgets coexist on
+        one bus: per-gang-step histogram samples, summed counters,
+        run-level gauges, one event, and the full document as the
+        ``xprof_gang`` snapshot section."""
+        from sparktorch_tpu.obs.telemetry import get_telemetry
+
+        tele = telemetry or get_telemetry()
+        for s in self.steps:
+            tele.observe("xprof.gang_step_wall_s", s.wall_s)
+            tele.observe("xprof.gang_comm_s", s.comm_s)
+            tele.observe("xprof.gang_step_skew_s", s.skew_s)
+            tele.observe("xprof.gang_comm_fraction", s.comm_fraction)
+            for fam, sec in s.families.items():
+                tele.observe("xprof.gang_collective_time_s", sec,
+                             labels={"op": fam})
+        for fam, n in self.family_counts().items():
+            tele.counter("xprof.gang_collectives_total", n,
+                         labels={"op": fam})
+        tele.counter("xprof.gang_steps_total", len(self.steps))
+        tele.counter("xprof.gang_merges_total")
+        tele.gauge("xprof.gang_ranks", self.n_ranks)
+        tele.gauge("xprof.gang_comm_fraction_run", self.comm_fraction)
+        tele.gauge("xprof.gang_overlap_fraction_run", self.overlap_fraction)
+        tele.gauge("xprof.gang_step_skew_s_max", self.step_skew_s)
+        tele.event(
+            "xprof_gang_analysis",
+            n_ranks=self.n_ranks,
+            n_steps=len(self.steps),
+            comm_s=self.comm_s,
+            compute_s=self.compute_s,
+            overlap_s=self.overlap_s,
+            step_skew_s=self.step_skew_s,
+            comm_fraction=self.comm_fraction,
+            overlap_fraction=self.overlap_fraction,
+            collective_s=self.family_s(),
+            gang_run_id=self.run_id,
+        )
+        tele.set_section("xprof_gang", self.to_dict())
+
+
+_RANK_LANE_KEYS = ("wall_s", "window_s", "compute_s", "comm_s", "overlap_s")
+
+
+def _analysis_dict(a: Any) -> Dict[str, Any]:
+    if isinstance(a, TraceAnalysis):
+        return a.to_dict()
+    if isinstance(a, dict):
+        return a
+    raise TypeError(f"cannot merge {type(a).__name__}: expected a "
+                    f"TraceAnalysis or its to_dict() form")
+
+
+def merge_analyses(analyses, ranks: Optional[Iterable[Any]] = None,
+                   run_id: Optional[str] = None) -> GangAnalysis:
+    """Fold N per-host analyses (objects or their ``to_dict()`` forms,
+    e.g. scraped ``xprof`` snapshot sections) into one
+    :class:`GangAnalysis`.
+
+    Steps are aligned by step number when every rank has one (the
+    normal annotated capture), by position otherwise; a rank missing a
+    step simply doesn't contribute to it (its ``n_ranks`` shrinks) —
+    truncated captures must not invent zeros that drag the max'd walls
+    down. Per-family comm seconds SUM, per-step walls MAX, skew is the
+    cross-rank wall spread (>= 0 by construction), and the gang
+    comm/overlap fractions are recomputed over the union of every
+    rank's windows."""
+    dicts = [_analysis_dict(a) for a in analyses]
+    if not dicts:
+        raise ValueError("merge_analyses: no analyses given")
+    rank_ids = [str(r) for r in ranks] if ranks is not None else [
+        str(i) for i in range(len(dicts))
+    ]
+    if len(rank_ids) != len(dicts):
+        raise ValueError(
+            f"merge_analyses: {len(rank_ids)} ranks for {len(dicts)} "
+            f"analyses"
+        )
+
+    # Alignment key: step number when every contributing step has one,
+    # else list position (whole-trace pseudo-steps merge positionally).
+    use_num = all(s.get("step") is not None
+                  for d in dicts for s in d.get("steps", []))
+    buckets: Dict[Any, List[Tuple[str, Dict[str, Any]]]] = {}
+    order: List[Any] = []
+    for rank, d in zip(rank_ids, dicts):
+        for i, s in enumerate(d.get("steps", [])):
+            key = s.get("step") if use_num else i
+            if key not in buckets:
+                buckets[key] = []
+                order.append(key)
+            buckets[key].append((rank, s))
+    if use_num:
+        order.sort()
+
+    steps: List[GangStepAttribution] = []
+    for key in order:
+        contrib = buckets[key]
+        walls = [s["wall_s"] for _, s in contrib]
+        families: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        lanes: Dict[str, Dict[str, float]] = {}
+        for rank, s in contrib:
+            for fam, sec in (s.get("families") or {}).items():
+                families[fam] = families.get(fam, 0.0) + sec
+            for fam, n in (s.get("counts") or {}).items():
+                counts[fam] = counts.get(fam, 0) + int(n)
+            lanes[rank] = {k: float(s.get(k, 0.0) or 0.0)
+                           for k in _RANK_LANE_KEYS}
+        steps.append(GangStepAttribution(
+            step=contrib[0][1].get("step") if use_num else None,
+            wall_s=max(walls),
+            window_s=max(s["window_s"] for _, s in contrib),
+            compute_s=sum(s["compute_s"] for _, s in contrib),
+            comm_s=sum(s["comm_s"] for _, s in contrib),
+            overlap_s=sum(s["overlap_s"] for _, s in contrib),
+            skew_s=max(walls) - min(walls),
+            n_ranks=len(contrib),
+            families=families,
+            counts=counts,
+            ranks=lanes,
+        ))
+    return GangAnalysis(
+        sources=[d.get("source", "<?>") for d in dicts],
+        n_ranks=len(dicts),
+        steps=steps,
+        run_id=run_id,
+    )
 
 
 def _iter_x_events(events: Iterable[Any]):
@@ -532,20 +807,58 @@ def analyze_trace(path_or_data, step_name: str = "train_step",
     )
 
 
+def check_capture_truncation(analysis: TraceAnalysis,
+                             expected_steps: Optional[int],
+                             telemetry=None) -> bool:
+    """The capture-truncation detector (ROADMAP follow-up): the
+    profiler's event buffer can overflow (a capture containing the
+    multi-second XLA compile) and later step markers silently vanish —
+    the analysis then under-reports without any signal. Compare the
+    steps ANNOTATED on the bus during the capture (``expected_steps``,
+    the ``tracing.annotated_steps`` delta the profiling hook measured)
+    against the markers actually FOUND in the trace; on a shortfall
+    emit one ``xprof.capture_truncated`` warning event + counter
+    instead of staying silent. Returns True when truncation was
+    detected."""
+    if expected_steps is None or expected_steps <= analysis.n_markers:
+        return False
+    from sparktorch_tpu.obs.telemetry import get_telemetry
+
+    tele = telemetry or get_telemetry()
+    _LOG.warning(
+        f"[sparktorch_tpu:xprof] capture truncated? {expected_steps} "
+        f"steps annotated on the bus but only {analysis.n_markers} "
+        f"train_step markers in the trace ({analysis.source}) — the "
+        f"profiler event buffer likely overflowed (keep compilation "
+        f"out of the capture); attribution below covers only the "
+        f"surviving markers"
+    )
+    tele.counter("xprof.capture_truncated_total")
+    tele.event("xprof.capture_truncated",
+               expected_steps=int(expected_steps),
+               found_markers=int(analysis.n_markers),
+               source=analysis.source)
+    return True
+
+
 def analyze_and_publish(log_dir: str, telemetry=None,
-                        step_name: str = "train_step"
+                        step_name: str = "train_step",
+                        expected_steps: Optional[int] = None
                         ) -> Optional[TraceAnalysis]:
     """The stop-profiler hook: find the capture under ``log_dir``,
-    analyze it, publish onto the bus. Analysis failures must never
-    fail the run that was being profiled — ANY exception (a torn
-    capture, an event shape this parser has not seen, a sink whose
-    disk filled during publish) logs, bumps
-    ``xprof.analyze_failures``, and returns None."""
+    analyze it, publish onto the bus. ``expected_steps`` (the number
+    of step annotations the capture should contain — measured by
+    ``profile_run`` from the bus counter) arms the truncation
+    detector. Analysis failures must never fail the run that was
+    being profiled — ANY exception (a torn capture, an event shape
+    this parser has not seen, a sink whose disk filled during publish)
+    logs, bumps ``xprof.analyze_failures``, and returns None."""
     from sparktorch_tpu.obs.telemetry import get_telemetry
 
     tele = telemetry or get_telemetry()
     try:
         analysis = analyze_trace(log_dir, step_name=step_name)
+        check_capture_truncation(analysis, expected_steps, tele)
         analysis.publish(tele)
         return analysis
     except Exception as e:

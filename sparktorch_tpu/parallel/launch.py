@@ -94,6 +94,8 @@ def bringup_multihost(
     heartbeat_timeout_ms: int = 30_000,
     start_coordinator: Optional[bool] = None,
     ft_policy=None,
+    run_id: Optional[str] = None,
+    telemetry=None,
 ):
     """Rendezvous the gang and initialize JAX's distributed runtime.
 
@@ -113,6 +115,13 @@ def bringup_multihost(
     refused), and REGISTRATION retries under the policy's backoff —
     a restarted rank dialing a coordinator that has not yet opened the
     new generation must not give up on the first DEAD/refused reply.
+
+    Run-ID correlation: the coordinator mints a gang-unique ``run_id``
+    (or adopts the one passed in) and announces it in its OK replies;
+    every rank stamps it on its telemetry events and heartbeat records
+    (``telemetry=`` wires this rank's run-scoped bus through to the
+    gang worker), so a fleet collector (:class:`obs.FleetCollector`)
+    can join the per-rank streams into one gang timeline.
     """
     if world_size <= 1:
         return None, None
@@ -122,6 +131,7 @@ def bringup_multihost(
         GangFailure,
         GangWorker,
     )
+    from sparktorch_tpu.obs.collector import mint_run_id
 
     if start_coordinator is None:
         start_coordinator = rank == 0
@@ -131,7 +141,8 @@ def bringup_multihost(
                     if ft_policy is not None else 0)
         coord = GangCoordinator(world_size=world_size, port=gang_port,
                                 heartbeat_timeout_ms=heartbeat_timeout_ms,
-                                rejoin_grace_ms=grace_ms)
+                                rejoin_grace_ms=grace_ms,
+                                run_id=run_id or mint_run_id())
         gang_port = coord.port
         coordinator_host = coordinator_host or _local_ip()
     elif coordinator_host is None:
@@ -139,14 +150,15 @@ def bringup_multihost(
 
     my_addr = f"{_local_ip()}:{jax_coord_port}"
     if ft_policy is None:
-        worker = GangWorker(coordinator_host, gang_port, rank, my_addr)
+        worker = GangWorker(coordinator_host, gang_port, rank, my_addr,
+                            telemetry=telemetry)
     else:
         rng = ft_policy.rng()
         attempt = 0
         while True:
             try:
                 worker = GangWorker(coordinator_host, gang_port, rank,
-                                    my_addr)
+                                    my_addr, telemetry=telemetry)
                 break
             except GangFailure:
                 if attempt >= ft_policy.restart.max_restarts:

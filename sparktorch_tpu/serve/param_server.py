@@ -353,6 +353,14 @@ class ParamServerHttp:
         wire_cache: dict = {"version": None, "host": None,
                             "dill": None, "bin": None}
         wire_lock = threading.Lock()
+        # Run-ID correlation: frames this server sends carry the
+        # 16-bit tag of its bus run_id; a push tagged with a DIFFERENT
+        # nonzero tag is a worker from another run (recycled port,
+        # stale supervisor) — counted + flagged, but still applied
+        # (the tag is a join key for the collector, not an ACL).
+        from sparktorch_tpu.obs.collector import run_tag as _run_tag
+
+        server_tag = _run_tag(ps.telemetry.run_id)
 
         def _cached_body(fmt: str):
             """(version, body) from ONE slot read — the handler's
@@ -376,7 +384,8 @@ class ParamServerHttp:
                     else:
                         wire_cache["bin"] = binwire.frame_bytes(
                             binwire.encode(wire_cache["host"],
-                                           version=version)
+                                           version=version,
+                                           run_tag=server_tag)
                         )
                 return version, wire_cache[fmt]
 
@@ -478,12 +487,18 @@ class ParamServerHttp:
                     t0 = time.perf_counter()
                     try:
                         _version, grads = binwire.decode(raw)
+                        frame_tag = binwire.frame_run_tag(raw)
                     except binwire.WireError:
                         # A malformed frame is the CLIENT's bug (or a
                         # truncated send): 400, and never counted
                         # against the server's tolerated apply errors.
                         self._send(400)
                         return
+                    if frame_tag and server_tag \
+                            and frame_tag != server_tag:
+                        ps.telemetry.counter(
+                            "param_server.run_tag_mismatches_total"
+                        )
                     try:
                         _chaos.fire("param_server.update", route=route)
                         ps.push_gradients(grads)

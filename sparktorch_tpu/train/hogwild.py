@@ -134,9 +134,11 @@ class HttpTransport:
         if retry_on_timeout:
             retriable = retriable + (TimeoutError,)
         try:
-            return urllib.request.urlopen(req, timeout=timeout)
+            return urllib.request.urlopen(  # lint-obs: ok (dill data wire)
+                req, timeout=timeout)
         except retriable:
-            return urllib.request.urlopen(req, timeout=timeout)  # retry once
+            return urllib.request.urlopen(  # lint-obs: ok (dill data wire)
+                req, timeout=timeout)  # retry once
 
     def pull(self, have_version: int):
         st = self.stats
@@ -568,8 +570,12 @@ def train_async(
                 push_quant = quant if quant else ("bf16" if compress
                                                   else None)
                 worker_transports = [
+                    # run_id from the shared run bus: pushes and pulls
+                    # carry the run's 16-bit tag in the frame header,
+                    # so cross-run traffic (a worker aimed at another
+                    # run's recycled port) is counted, never silent.
                     BinaryTransport(http.url, quant=push_quant,
-                                    telemetry=tele)
+                                    telemetry=tele, run_id=tele.run_id)
                     for _ in range(n_workers)
                 ]
             else:

@@ -63,6 +63,11 @@ def profile_run(log_dir: Optional[str], telemetry=None,
 
     tele = telemetry or get_telemetry()
     tele.counter("tracing.profile_runs")
+    # Baseline for the truncation detector: the delta of this counter
+    # across the capture is how many step annotations the trace SHOULD
+    # contain; fewer markers found means the profiler's event buffer
+    # overflowed and dropped them (silent under-reporting otherwise).
+    steps_before = tele.counter_value("tracing.annotated_steps")
     # Deliberately NOT a span: a span here would sit on the thread-
     # local stack for the whole run and re-path every trainer span
     # underneath it — metric names must not depend on whether
@@ -91,8 +96,13 @@ def profile_run(log_dir: Optional[str], telemetry=None,
             # and bumps xprof.analyze_failures, never raises).
             from sparktorch_tpu.obs.xprof import analyze_and_publish
 
-            handle["analysis"] = analyze_and_publish(log_dir,
-                                                     telemetry=tele)
+            expected = int(
+                tele.counter_value("tracing.annotated_steps") - steps_before
+            )
+            handle["analysis"] = analyze_and_publish(
+                log_dir, telemetry=tele,
+                expected_steps=expected if expected > 0 else None,
+            )
 
 
 def step_annotation(step: int, telemetry=None):

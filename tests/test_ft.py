@@ -200,6 +200,104 @@ def test_supervisor_straggler_warns_once_per_episode(tmp_path):
                               labels=labels) == 2
 
 
+def _broken_exporter(mode: str):
+    """An HTTP server whose /heartbeats is broken in a named way:
+    'http500' answers 500, 'torn' sends invalid JSON, 'junk_keys'
+    sends well-formed JSON with non-numeric rank keys."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if mode == "http500":
+                self.send_response(500)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = (b'{"ranks": {' if mode == "torn"
+                    else b'{"ranks": {"not-a-rank": {"alive": true}}}')
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+@pytest.mark.parametrize("mode", ["http500", "torn", "junk_keys"])
+def test_supervisor_exporter_scrape_failures_degrade(mode):
+    """The exporter-scraping path under failure (ISSUE satellite): an
+    exporter answering 500, serving torn JSON, or replying with a
+    shape the reader doesn't expect must degrade to a warning +
+    ft_scrape_errors_total — _report() returns None, the skew/stall
+    policies skip the tick, and the supervision run COMPLETES."""
+    httpd = _broken_exporter(mode)
+    tele = Telemetry(run_id=f"scrape_{mode}")
+    try:
+        pol = FtPolicy(restart=RestartPolicy(max_restarts=0),
+                       straggler=StragglerPolicy(warn_skew_steps=5))
+        sup = Supervisor(
+            policy=pol, telemetry=tele,
+            exporter_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+        )
+        assert sup._report() is None
+        assert tele.counter_value("ft_scrape_errors_total",
+                                  labels={"source": "exporter"}) == 1
+        # The poll loop survives the broken exporter end to end.
+        sup.add("w", lambda attempt: ThreadWorker(
+            "w", lambda: time.sleep(0.15)), rank=0)
+        summary = sup.run()
+        assert summary["failed"] == []
+        assert tele.counter_value("ft_scrape_errors_total",
+                                  labels={"source": "exporter"}) >= 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_supervisor_exporter_vanished_mid_poll_degrades():
+    """An exporter that dies BETWEEN polls (connection refused) is the
+    same degradation: None report, counter, run completes."""
+    httpd = _broken_exporter("junk_keys")
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()  # vanished: nothing listens anymore
+
+    tele = Telemetry(run_id="scrape_vanish")
+    sup = Supervisor(policy=_policy(), telemetry=tele, exporter_url=url)
+    assert sup._report() is None
+    assert tele.counter_value("ft_scrape_errors_total",
+                              labels={"source": "exporter"}) == 1
+    sup.add("w", lambda attempt: ThreadWorker("w", lambda: None), rank=0)
+    assert sup.run()["failed"] == []
+
+
+def test_supervisor_exporter_happy_path_still_reports():
+    """The hardening must not break the working scrape: a real gang
+    exporter over a heartbeat dir keeps feeding the skew policies."""
+    import tempfile
+
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+    from sparktorch_tpu.obs.heartbeat import HeartbeatEmitter
+
+    with tempfile.TemporaryDirectory() as d:
+        HeartbeatEmitter(d, rank=0).notify_step(50)
+        HeartbeatEmitter(d, rank=1).notify_step(7)
+        with GangMetricsExporter(heartbeat_dir=d) as exporter:
+            sup = Supervisor(policy=_policy(),
+                             telemetry=Telemetry(run_id="scrape_ok"),
+                             exporter_url=exporter.url)
+            report = sup._report()
+    assert report is not None
+    assert report["ranks"][0]["step"] == 50  # re-keyed to int
+    assert report["step_skew"] == 43
+
+
 # ---------------------------------------------------------------------------
 # Checkpoint auto-discovery (latest_step)
 # ---------------------------------------------------------------------------

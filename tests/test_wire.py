@@ -205,6 +205,59 @@ def test_binary_routes_pull_304_push_and_counters(payload):
         server.stop()
 
 
+def test_run_tag_correlation_rides_binary_wire(payload):
+    """Run-ID correlation on the data wire: frames carry the gang run
+    tag in the header's reserved bytes. Same run -> tags match, no
+    mismatch counters; a worker tagged with a DIFFERENT run pushes/
+    pulls against this server -> both sides count the cross-run
+    traffic (it still applies — the tag is a join key, not an ACL)."""
+    from sparktorch_tpu.obs import Telemetry, run_tag
+
+    tele = Telemetry(run_id="gang-run-A")
+    server = ParameterServer(payload, window_len=2, telemetry=tele)
+    http = ParamServerHttp(server, port=0).start()
+    try:
+        same = BinaryTransport(http.url, quant=None, telemetry=tele,
+                               run_id="gang-run-A")
+        assert same.run_tag == run_tag("gang-run-A") != 0
+        v0, params = same.pull(-1)
+        grads = {k: {kk: np.ones_like(np.asarray(vv))
+                     for kk, vv in v.items()}
+                 if isinstance(v, dict) else np.ones_like(np.asarray(v))
+                 for k, v in params.items()}
+        same.push(grads)
+        server.drain()
+        assert tele.counter_value(
+            "param_server.run_tag_mismatches_total") == 0
+        assert tele.counter_value(
+            "transport_run_tag_mismatches_total",
+            labels={"host": "127.0.0.1", "port": http.port}) == 0
+
+        other_tele = Telemetry(run_id="other")
+        other = BinaryTransport(http.url, quant=None, telemetry=other_tele,
+                                run_id="gang-run-B")
+        assert other.pull(-1) is not None  # server frame tags A, we're B
+        other.push(grads)
+        server.drain()
+        assert server.applied_updates == 2  # correlation, not rejection
+        assert tele.counter_value(
+            "param_server.run_tag_mismatches_total") == 1
+        assert other_tele.counter_value(
+            "transport_run_tag_mismatches_total",
+            labels={"host": "127.0.0.1", "port": http.port}) == 1
+
+        # Untagged (legacy) clients never look like mismatches.
+        legacy = BinaryTransport(http.url, quant=None)
+        assert legacy.run_tag == 0
+        legacy.push(grads)
+        server.drain()
+        assert tele.counter_value(
+            "param_server.run_tag_mismatches_total") == 1
+    finally:
+        http.stop()
+        server.stop()
+
+
 def test_binary_update_rejects_malformed_frame(payload):
     server = ParameterServer(payload, window_len=2)
     http = ParamServerHttp(server, port=0).start()
