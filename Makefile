@@ -6,7 +6,7 @@ PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
-	cluster-up clean lint-obs
+	bench-ps-fleet cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -139,6 +139,17 @@ bench-trace:
 # once. Backend-free — no devices needed.
 bench-gang-obs:
 	$(PYTHON) -m sparktorch_tpu.bench --config gang_obs
+
+# Parameter-server fleet gate: under a sparse-update worker swarm, a
+# 4-shard fleet must beat the single server on aggregate pull
+# bandwidth AND p99 pull latency (medians over interleaved repeats),
+# per-tensor delta pulls must ship strictly fewer bytes than full
+# pulls (and int8 deltas fewer than f32 deltas), and a seeded shard
+# kill during a real train_async(shards=4) run must complete with
+# exact record counts and a monitored shard restart — FAILS otherwise.
+# Runs on any backend (JAX_PLATFORMS=cpu works).
+bench-ps-fleet:
+	$(PYTHON) -m sparktorch_tpu.bench --config hogwild_ps_fleet
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

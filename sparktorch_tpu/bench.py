@@ -709,6 +709,311 @@ def bench_hogwild_chaos() -> dict:
     }
 
 
+def bench_hogwild_ps_fleet() -> dict:
+    """Parameter-server FLEET gate (``make bench-ps-fleet``): the
+    sharded tier must actually beat the single server where it
+    claims to — FAILS (raises) otherwise.
+
+    Workload: a ~28 MB MLP state dict under a SPARSE-update pusher (a
+    stable hot quarter of the leaves receives closed-loop gradient
+    pushes — the fine-tuning/embedding shape the delta wire exists
+    for) while a swarm of stateful workers each completes a fixed
+    quota of FRESH pulls at a step cadence. The single server's v1
+    wire must re-ship the full tree on every fresh pull (and apply
+    dense gradients); the 4-shard fleet ships per-tensor deltas and
+    applies the sparse partials shard-parallel. Legs run interleaved
+    x3 and gate on MEDIANS (this rig is CPU-share capped and noisy).
+
+    Gates:
+    - aggregate pull bandwidth (model-state refreshed per second
+      across the swarm: quota x model bytes / leg wall) — fleet must
+      beat the single server;
+    - p99 fresh-pull latency — fleet must beat the single server;
+    - wire bytes per fresh pull — the fleet's deltas must ship
+      STRICTLY fewer bytes than the single server's full snapshots
+      (and the int8 delta leg strictly fewer than the f32 delta leg);
+    - a seeded shard kill (``ft.chaos`` ``fleet.shard`` site) during
+      a real ``train_async(shards=4)`` run must complete with exact
+      record counts and >= 1 monitored shard restart.
+    """
+    import threading
+
+    import jax
+
+    from sparktorch_tpu.ft import ChaosConfig, inject
+    from sparktorch_tpu.models import MLP
+    from sparktorch_tpu.net import wire as _wire
+    from sparktorch_tpu.net.sharded import ShardedTransport
+    from sparktorch_tpu.net.transport import BinaryTransport
+    from sparktorch_tpu.obs import Telemetry, get_telemetry
+    from sparktorch_tpu.serve.fleet import ParamServerFleet
+    from sparktorch_tpu.serve.param_server import (
+        ParameterServer,
+        ParamServerHttp,
+    )
+    from sparktorch_tpu.train.hogwild import train_async
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    tele = get_telemetry()
+    n_shards, workers, quota, cadence_s = 4, 6, 10, 0.005
+    with tele.span("bench/init") as _sp_init:
+        # ~67 MB of parameters: big enough that per-pull BYTES dwarf
+        # this rig's scheduler jitter (cpu-share-capped container;
+        # ±100-300 ms thread-starvation spikes are routine), so the
+        # p99 gate measures the wire design, not the noise floor.
+        spec = ModelSpec(module=MLP(features=[1024] * 16 + [10]),
+                         loss="cross_entropy", optimizer="sgd",
+                         optimizer_params={"lr": 1e-2},
+                         input_shape=(784,))
+
+    def _swarm_leg(make_pull, push_fn) -> dict:
+        """Closed-loop pusher + W stateful pullers, each completing
+        ``quota`` fresh pulls; per-pull latency and wire bytes out.
+        Every transport opened here is closed before the leg returns
+        (7 legs per bench run — leaked keep-alive sockets and fan-out
+        pools would pile up for the life of the process)."""
+        stop = threading.Event()
+        lat: List[float] = []
+        lock = threading.Lock()
+        wire_bytes = [0]
+        opened: list = []
+
+        def pusher():
+            while not stop.is_set():
+                push_fn()  # wait=True: version cadence = apply capacity
+                time.sleep(cadence_s)
+
+        def puller():
+            pull, bytes_fn, transport = make_pull()
+            with lock:
+                opened.append(transport)
+            # Untimed initial sync (both legs ship the full model here
+            # — a one-time cost); the measured quota is STEADY-STATE
+            # pulls, which is where delta and full genuinely differ.
+            have = -1
+            snap = pull(have)
+            if snap is not None:
+                have = snap[0]
+            done, mine, b0 = 0, [], bytes_fn()
+            # Hard deadline: a server whose writer died stops minting
+            # versions, every pull 304s forever, and without this the
+            # leg would hang instead of failing the gate.
+            deadline = time.monotonic() + 120.0
+            while done < quota and time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                snap = pull(have)
+                dt = time.perf_counter() - t0
+                if snap is not None:
+                    have, done = snap[0], done + 1
+                    mine.append(dt)
+                time.sleep(cadence_s)
+            with lock:
+                lat.extend(mine)
+                wire_bytes[0] += bytes_fn() - b0
+
+        pt = threading.Thread(target=pusher, daemon=True)
+        pt.start()
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=puller, daemon=True)
+                   for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        pt.join()
+        for transport in opened:
+            transport.close()
+        pulls = workers * quota
+        if len(lat) < pulls:
+            raise AssertionError(
+                f"swarm leg stalled: {len(lat)}/{pulls} fresh pulls "
+                f"completed before the 120s deadline — the server "
+                f"stopped minting versions (dead writer?)"
+            )
+        return {
+            "wall_s": wall,
+            "state_mb_per_s": pulls * model_nbytes / wall / 1e6,
+            "wire_mb_per_s": wire_bytes[0] / wall / 1e6,
+            "wire_mb_per_pull": wire_bytes[0] / pulls / 1e6,
+            "pull_p50_ms": float(np.percentile(lat, 50)) * 1e3,
+            "pull_p99_ms": float(np.percentile(lat, 99)) * 1e3,
+        }
+
+    def _single_leg() -> dict:
+        server = ParameterServer(spec, window_len=workers)
+        http = ParamServerHttp(server, port=0).start()
+        try:
+            _, params = server.slot.read()
+            zero_full = jax.tree.map(
+                lambda a: np.zeros_like(np.asarray(a)), params)
+
+            def push():
+                try:
+                    server.push_gradients(zero_full, wait=True)
+                except Exception:
+                    pass  # a raced stop must not kill the leg
+
+            def make_pull():
+                t = BinaryTransport(http.url, quant=None)
+                return (lambda have: t.pull(have)), (
+                    lambda: t.stats["pull_bytes"]), t
+
+            push()
+            server.drain()
+            pull, _b, t = make_pull()  # warm render + connection path
+            pull(-1)
+            t.close()
+            return _swarm_leg(make_pull, push)
+        finally:
+            http.stop()
+            server.stop()
+
+    def _fleet_leg(pull_quant=None) -> dict:
+        fleet = ParamServerFleet(spec, n_shards=n_shards).start()
+        try:
+            def push():
+                try:
+                    fleet.scatter_push(hot_partial, wait=True)
+                except Exception:
+                    pass
+
+            def make_pull():
+                t = ShardedTransport(fleet, pull_quant=pull_quant)
+                return (lambda have: t.pull(have)), (
+                    lambda: t.stats["pull_bytes"]), t
+
+            push()
+            fleet.drain()
+            pull, _b, t = make_pull()
+            pull(-1)
+            t.close()
+            return _swarm_leg(make_pull, push)
+        finally:
+            fleet.stop()
+
+    with tele.span("bench/compile_warmup") as _sp_warm:
+        # One throwaway fleet warms the per-shard apply jits and leaf
+        # partitioning; the measured legs then start compile-free
+        # (same persistent-cache contract as every other config).
+        probe = ParamServerFleet(spec, n_shards=n_shards)
+        flat = {p: np.asarray(a)
+                for p, a in _wire.flatten_tree(probe.assemble())}
+        model_nbytes = sum(a.nbytes for a in flat.values())
+        paths = sorted(flat)
+        hot = paths[:max(1, len(paths) // 4)]
+        hot_partial = {p: np.zeros_like(flat[p]) for p in hot}
+        probe.scatter_push(hot_partial, wait=True)
+        probe.stop()
+
+    with tele.span("bench/measure") as _sp_measure:
+        singles, fleets = [], []
+        for _ in range(3):  # interleaved: rig noise hits both legs
+            singles.append(_single_leg())
+            fleets.append(_fleet_leg())
+        int8 = _fleet_leg(pull_quant="int8")
+
+    def _median(legs, key):
+        return float(np.median([leg[key] for leg in legs]))
+
+    single = {k: round(_median(singles, k), 3) for k in singles[0]}
+    fleet = {k: round(_median(fleets, k), 3) for k in fleets[0]}
+    bw_ratio = fleet["state_mb_per_s"] / max(single["state_mb_per_s"], 1e-9)
+    p99_ratio = fleet["pull_p99_ms"] / max(single["pull_p99_ms"], 1e-9)
+
+    # -- seeded shard kill during a real sharded training run ----------
+    with tele.span("bench/shard_kill") as _sp_kill:
+        rng = np.random.default_rng(0)
+        x = np.concatenate([rng.normal(0, 1, (100, 10)),
+                            rng.normal(2, 1, (100, 10))]).astype(np.float32)
+        y = np.concatenate([np.zeros(100),
+                            np.ones(100)]).astype(np.float32)
+        from sparktorch_tpu import serialize_torch_obj
+        from sparktorch_tpu.models import ClassificationNet
+
+        clf = serialize_torch_obj(
+            ClassificationNet(n_classes=2), criterion="cross_entropy",
+            optimizer="adam", optimizer_params={"lr": 5e-3},
+            input_shape=(10,),
+        )
+        kill_tele = Telemetry(run_id="bench_ps_fleet_kill")
+        iters, parts = 12, 2
+        with inject(ChaosConfig(kill_shard_at={1: 4}, seed=0),
+                    telemetry=kill_tele) as inj:
+            result = train_async(clf, x, labels=y, iters=iters,
+                                 partitions=parts, seed=0,
+                                 transport="http", shards=n_shards,
+                                 telemetry=kill_tele)
+        kill_fired = len([e for e in inj.events
+                          if e["site"] == "fleet.shard"])
+        kill_records = len(result.metrics)
+        kill_restarts = int(result.summary["fleet"]["shard_restarts"])
+
+    # -- the gates ------------------------------------------------------
+    if not bw_ratio > 1.0:
+        raise AssertionError(
+            f"fleet aggregate pull bandwidth did not beat the single "
+            f"server: {fleet['state_mb_per_s']:.0f} vs "
+            f"{single['state_mb_per_s']:.0f} MB/s (x{bw_ratio:.2f})"
+        )
+    if not p99_ratio < 1.0:
+        raise AssertionError(
+            f"fleet p99 pull latency did not beat the single server: "
+            f"{fleet['pull_p99_ms']:.0f} vs "
+            f"{single['pull_p99_ms']:.0f} ms (x{p99_ratio:.2f})"
+        )
+    if not fleet["wire_mb_per_pull"] < single["wire_mb_per_pull"]:
+        raise AssertionError(
+            f"delta pulls did not ship fewer bytes than full pulls: "
+            f"{fleet['wire_mb_per_pull']:.2f} vs "
+            f"{single['wire_mb_per_pull']:.2f} MB/pull"
+        )
+    if not int8["wire_mb_per_pull"] < fleet["wire_mb_per_pull"]:
+        raise AssertionError(
+            f"int8 delta pulls did not ship fewer bytes than f32 "
+            f"deltas: {int8['wire_mb_per_pull']:.2f} vs "
+            f"{fleet['wire_mb_per_pull']:.2f} MB/pull"
+        )
+    if kill_fired < 1:
+        raise AssertionError("seeded shard kill never fired")
+    if kill_records != iters * parts:
+        raise AssertionError(
+            f"shard-kill run lost records: {kill_records} != "
+            f"{iters * parts}"
+        )
+    if kill_restarts < 1:
+        raise AssertionError(
+            "shard kill produced no monitored restart "
+            "(fleet.shard_restarts_total empty)"
+        )
+
+    return {
+        "config": "hogwild_ps_fleet", "unit": "x (bandwidth ratio)",
+        "value": round(bw_ratio, 3),
+        "n_shards": n_shards, "workers": workers, "quota": quota,
+        "model_mb": round(model_nbytes / 1e6, 1),
+        "hot_leaves": len(hot), "total_leaves": len(paths),
+        "bandwidth_ratio": round(bw_ratio, 3),
+        "p99_ratio": round(p99_ratio, 3),
+        "single": single, "fleet": fleet, "fleet_int8": int8,
+        "delta_bytes_saved_pct": round(
+            100 * (1 - fleet["wire_mb_per_pull"]
+                   / single["wire_mb_per_pull"]), 1),
+        "int8_bytes_saved_pct": round(
+            100 * (1 - int8["wire_mb_per_pull"]
+                   / fleet["wire_mb_per_pull"]), 1),
+        "shard_kill": {"fired": kill_fired, "records": kill_records,
+                       "restarts": kill_restarts},
+        "phase_s": {
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+            "shard_kill": round(_sp_kill.duration_s, 3),
+        },
+    }
+
+
 def _prior_comm_budget(config: str,
                        root: Optional[str] = None) -> Optional[dict]:
     """The most recent PRIOR round's record for ``config`` that
@@ -1769,6 +2074,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_wire": bench_hogwild_wire,
     "hogwild_chaos": bench_hogwild_chaos,
     "hogwild_chaos_soak": bench_hogwild_chaos_soak,
+    "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "sharded_trace": bench_sharded_trace,
     "gang_obs": bench_gang_obs,
     "bert_dp": bench_bert_dp,

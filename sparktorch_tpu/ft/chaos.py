@@ -69,6 +69,13 @@ class ChaosConfig:
     # Truncate the next K binary pull bodies server-side (client must
     # fail with WireError, never hang or half-decode).
     truncate_pull_frames: int = 0
+    # shard id -> Nth request (1-based) at which that param-server
+    # fleet shard's HTTP frontend dies mid-conversation (one-shot, so
+    # the monitor-restarted frontend survives). Clients must degrade
+    # to the remaining ring inside their grace window; the fleet
+    # monitor must bring the shard back.
+    kill_shard_at: Mapping[Any, int] = dataclasses.field(
+        default_factory=dict)
 
 
 class ChaosInjector:
@@ -89,6 +96,8 @@ class ChaosInjector:
         self._drops_left = int(config.drop_connections)
         self._errors_left = int(config.server_error_pushes)
         self._truncs_left = int(config.truncate_pull_frames)
+        self._shard_requests: Dict[str, int] = {}
+        self._shard_kills_fired: set = set()
 
     def _record(self, site: str, **ctx: Any) -> None:
         self.events.append({"site": site, **ctx})
@@ -147,6 +156,21 @@ class ChaosInjector:
                     self._truncs_left -= 1
                     self._record(site, **ctx)
                     return {"truncate": True}
+        elif site == "fleet.shard":
+            shard = str(ctx.get("shard"))
+            at = next((int(v) for k, v in cfg.kill_shard_at.items()
+                       if str(k) == shard), None)
+            if at is not None:
+                with self._lock:
+                    count = self._shard_requests.get(shard, 0) + 1
+                    self._shard_requests[shard] = count
+                    if count >= at and shard not in self._shard_kills_fired:
+                        # One-shot per shard: the restarted frontend's
+                        # requests must survive their rerun.
+                        self._shard_kills_fired.add(shard)
+                        self._record(site, shard=shard,
+                                     route=ctx.get("route"))
+                        return {"die": True}
         return None
 
 

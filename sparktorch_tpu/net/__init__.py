@@ -20,8 +20,18 @@ from sparktorch_tpu.net.wire import (
     unflatten_tree,
 )
 from sparktorch_tpu.net.transport import BinaryTransport, TransportError
+from sparktorch_tpu.net.sharded import (
+    HashRing,
+    HttpFleetView,
+    ShardedTransport,
+    StaticFleetView,
+)
 
 __all__ = [
+    "HashRing",
+    "HttpFleetView",
+    "ShardedTransport",
+    "StaticFleetView",
     "WIRE_CONTENT_TYPE",
     "QuantLeaf",
     "WireError",

@@ -149,11 +149,21 @@ class BinaryTransport:
         self._drop_connection()
 
     def _request(self, method: str, path: str, body=None,
-                 headers: Optional[Dict[str, str]] = None,
+                 headers=None,
                  timeout: float = _TIMEOUT,
-                 retry_on_timeout: bool = False) -> Tuple[int, bytes]:
+                 retry_on_timeout: bool = False
+                 ) -> Tuple[int, bytes, Dict[str, str]]:
         """One request over the persistent connection, with reconnect +
-        exponential backoff on connection-level failures.
+        exponential backoff on connection-level failures. Returns
+        ``(status, body, reply_headers)``.
+
+        ``headers`` may be a dict or a CALLABLE re-evaluated on every
+        attempt: a retried pull must re-read its live version state at
+        send time, not replay the value captured before the first
+        attempt — between a failed send and its reconnect the client's
+        merged state can advance, and replaying the stale
+        ``X-Have-Version`` would make the server re-ship (or worse,
+        304-skip) tensors the client already holds.
 
         Timeouts retry only when the caller marks the request
         IDEMPOTENT (pulls/polls): a timed-out POST may have completed
@@ -183,10 +193,11 @@ class BinaryTransport:
                     # way a server-closed keep-alive socket would, so
                     # the real reconnect+backoff path runs.
                     raise ConnectionResetError("chaos: connection dropped")
-                conn.request(method, path, body=body, headers=headers or {})
+                hdrs = headers() if callable(headers) else (headers or {})
+                conn.request(method, path, body=body, headers=hdrs)
                 resp = conn.getresponse()
                 data = resp.read()  # drain so the connection is reusable
-                return resp.status, data
+                return resp.status, data, dict(resp.headers)
             except TimeoutError as e:
                 self._drop_connection()
                 last = e
@@ -204,15 +215,31 @@ class BinaryTransport:
 
     # -- hogwild transport contract ---------------------------------------
 
-    def pull(self, have_version: int):
+    def _check_run_tag(self, body) -> None:
+        frame_tag = wire.frame_run_tag(body)
+        if frame_tag and self.run_tag and frame_tag != self.run_tag:
+            tele = self.telemetry
+            if tele is None:
+                from sparktorch_tpu.obs import get_telemetry
+
+                tele = self.telemetry = get_telemetry()
+            tele.counter("transport_run_tag_mismatches_total",
+                         labels={"host": self.host, "port": self.port})
+
+    def pull(self, have_version):
         """``(version, params)`` newer than ``have_version``, or None
         when the server's snapshot is not newer (its 304 reply — the
-        ETag-style exchange that costs ~100 header bytes, not a model)."""
+        ETag-style exchange that costs ~100 header bytes, not a model).
+
+        ``have_version`` may be a CALLABLE returning the live value:
+        it is re-read on every reconnect attempt (see ``_request``)."""
         st = self.stats
         t0 = time.perf_counter()
-        status, body = self._request(
+        status, body, _ = self._request(
             "GET", "/parameters.bin",
-            headers={"X-Have-Version": str(int(have_version))},
+            headers=lambda: {"X-Have-Version": str(int(
+                have_version() if callable(have_version) else have_version
+            ))},
             timeout=self.pull_timeout, retry_on_timeout=True,
         )
         st["pull_s"] += time.perf_counter() - t0
@@ -223,17 +250,76 @@ class BinaryTransport:
             raise TransportError(f"/parameters.bin -> {status}")
         st["pull_fresh"] += 1
         st["pull_bytes"] += len(body)
-        frame_tag = wire.frame_run_tag(body)
-        if frame_tag and self.run_tag and frame_tag != self.run_tag:
-            tele = self.telemetry
-            if tele is None:
-                from sparktorch_tpu.obs import get_telemetry
-
-                tele = self.telemetry = get_telemetry()
-            tele.counter("transport_run_tag_mismatches_total",
-                         labels={"host": self.host, "port": self.port})
+        self._check_run_tag(body)
         version, tree = wire.decode(body)
         return version, tree
+
+    def pull_delta(self, have_version,
+                   quant: Optional[str] = None) -> Dict[str, Any]:
+        """Per-tensor delta pull from the fleet's ``/delta.bin`` route.
+
+        ``have_version`` (int or callable, re-read per reconnect
+        attempt) is the client's last version FROM THIS SERVER; the
+        reply carries only leaves whose per-tensor version advanced.
+        ``quant='int8'`` asks the server for int8 leaves with
+        server-side error feedback (the reply dequantizes here).
+
+        Returns a dict: ``fresh`` (False on 304), ``version``,
+        ``leaves`` (``{path: array}``), ``leaf_versions``, ``nbytes``,
+        plus the resync metadata every reply carries — ``epoch`` (the
+        server slot's boot nonce; a change means the server state was
+        rebuilt and the client must re-pull from -1) and
+        ``ring_version`` (bumped on shard add/drain; a change means
+        refresh the shard map).
+        """
+        st = self.stats
+        t0 = time.perf_counter()
+
+        def _headers() -> Dict[str, str]:
+            hv = have_version() if callable(have_version) else have_version
+            h = {"X-Have-Version": str(int(hv))}
+            if quant:
+                h["X-Pull-Quant"] = quant
+            return h
+
+        status, body, rhdrs = self._request(
+            "GET", "/delta.bin", headers=_headers,
+            timeout=self.pull_timeout, retry_on_timeout=True,
+        )
+        st["pull_s"] += time.perf_counter() - t0
+        st["pulls"] += 1
+        out: Dict[str, Any] = {
+            "fresh": False, "version": None, "leaves": {},
+            "leaf_versions": {}, "nbytes": 0,
+            "epoch": _int_header(rhdrs, "X-Slot-Epoch"),
+            "ring_version": _int_header(rhdrs, "X-Ring-Version"),
+        }
+        if status == 304:
+            return out
+        if status != 200:
+            raise TransportError(f"/delta.bin -> {status}")
+        st["pull_fresh"] += 1
+        st["pull_bytes"] += len(body)
+        self._check_run_tag(body)
+        version, leaves, leaf_versions = wire.decode_delta(body)
+        out.update(fresh=True, version=version, leaves=leaves,
+                   leaf_versions=leaf_versions, nbytes=len(body))
+        return out
+
+    def fetch_json(self, path: str, timeout: Optional[float] = None) -> Any:
+        """GET + parse a small JSON control route (``/fleet.json``)
+        over the SAME keep-alive connection and retry discipline as
+        the data wire."""
+        status, body, _ = self._request(
+            "GET", path, timeout=timeout or self.timeout,
+            retry_on_timeout=True,
+        )
+        if status != 200:
+            raise TransportError(f"{path} -> {status}")
+        try:
+            return json.loads(body)
+        except ValueError as e:
+            raise TransportError(f"{path}: invalid JSON: {e}") from e
 
     def push(self, grads) -> None:
         """Encode (optionally quantize with error feedback) and POST
@@ -256,7 +342,7 @@ class BinaryTransport:
         # The buffer LIST (not an iterator): http.client scatter-sends
         # each part, and a connection-level retry can re-iterate it —
         # an exhausted iterator would under-send the declared length.
-        status, _ = self._request(
+        status, _, _ = self._request(
             "POST", "/update.bin", body=buffers,
             headers={"Content-Length": str(nbytes),
                      "Content-Type": wire.CONTENT_TYPE},
@@ -273,7 +359,7 @@ class BinaryTransport:
         and keeping it readable beats keeping it binary)."""
         t0 = time.perf_counter()
         payload = json.dumps({"loss": float(loss)}).encode()
-        status, body = self._request(
+        status, body, _ = self._request(
             "POST", "/losses.json", body=payload,
             headers={"Content-Type": "application/json"},
             timeout=self.timeout,
@@ -284,9 +370,21 @@ class BinaryTransport:
         return bool(json.loads(body)["stop"])
 
     def alive(self) -> bool:
-        status, _ = self._request("GET", "/", timeout=self.timeout,
-                                  retry_on_timeout=True)
+        status, _, _ = self._request("GET", "/", timeout=self.timeout,
+                                     retry_on_timeout=True)
         return status == 200
+
+
+def _int_header(headers: Dict[str, str], name: str) -> Optional[int]:
+    """Parse an int reply header; None when absent or garbled (an old
+    server that doesn't send it must read as 'unknown', not 0)."""
+    raw = headers.get(name)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 def _tree_to_host(tree: Any):

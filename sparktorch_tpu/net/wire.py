@@ -27,7 +27,16 @@ The table mirrors the tree: interior nodes are JSON objects (each
 dict key travels ONCE, like pickle's memo — the table stays smaller
 than dill's per-array overhead), leaves are ``[dtype-str, shape]``
 (plus ``{"scale": s, "d": dequant-dtype}`` for int8-quantized
-tensors). Offsets are implicit: payload buffers are laid out in the
+tensors). Wire version 2 — the DELTA frame the sharded fleet's
+``/delta.bin`` route serves — extends each leaf entry to
+``[dtype, shape, quant-or-null, leaf_version]``: a per-tensor version
+tag beside the frame's global snapshot version, so a pull can ship
+only the tensors whose version advanced and the client can merge them
+into its cached tree. Version-1 frames stay byte-identical (old
+decoders never see a v2 frame unless they ask the delta route for
+one, and then they fail loudly on the version byte — the
+mixed-version-gang story rides the unchanged v1 wire).
+Offsets are implicit: payload buffers are laid out in the
 table's depth-first traversal order, which JSON preserves. Encoding
 never copies tensor bytes: :func:`encode` returns the header plus
 ``memoryview``s of the arrays themselves, ready for scatter-write
@@ -62,6 +71,11 @@ except ImportError:  # pragma: no cover - jax deps always ship ml_dtypes
 
 MAGIC = b"STWR"
 WIRE_VERSION = 1
+# Delta frames: same header/payload layout, but leaf table entries
+# carry a 4th element (the per-tensor version tag) and the tree may be
+# PARTIAL (only the advanced leaves). A separate wire version so v1
+# decoders reject delta frames loudly instead of mis-merging them.
+WIRE_VERSION_DELTA = 2
 # magic, version, flags, run tag, snapshot version, table len, payload len
 _HEADER = struct.Struct("<4sBBHqIQ")
 HEADER_SIZE = _HEADER.size
@@ -180,6 +194,24 @@ def _is_float(arr: np.ndarray) -> bool:
     return _BFLOAT16 is not None and arr.dtype == _BFLOAT16
 
 
+def quantize_leaf_int8(
+    value: np.ndarray, residual: Optional[np.ndarray] = None
+) -> Tuple[QuantLeaf, np.ndarray]:
+    """Symmetric per-tensor int8 quantization of ONE float leaf, with
+    the error-feedback residual returned to the caller (add it to the
+    next quantization of the same leaf). The per-leaf primitive under
+    :func:`quantize_tree`, exposed so the fleet's server-side pull
+    quantization can keep residuals per (path, version) instead of
+    per whole-tree call."""
+    value = np.asarray(value, dtype=np.float32)
+    if residual is not None:
+        value = value + residual
+    amax = float(np.max(np.abs(value))) if value.size else 0.0
+    scale = amax / 127.0 if amax > 0 else 1.0
+    q = np.clip(np.rint(value / scale), -127, 127).astype(np.int8)
+    return QuantLeaf(q, scale, "<f4"), value - q.astype(np.float32) * scale
+
+
 def quantize_tree(
     tree: Any,
     mode: str,
@@ -220,12 +252,12 @@ def quantize_tree(
                 new_residuals[path] = value - q.astype(np.float32)
             leaves.append((path, q))
         else:
-            amax = float(np.max(np.abs(value))) if value.size else 0.0
-            scale = amax / 127.0 if amax > 0 else 1.0
-            q = np.clip(np.rint(value / scale), -127, 127).astype(np.int8)
+            # value already carries the residual (added above); pass
+            # residual=None so it isn't applied twice.
+            qleaf, err = quantize_leaf_int8(value)
             if residuals is not None:
-                new_residuals[path] = value - q.astype(np.float32) * scale
-            leaves.append((path, QuantLeaf(q, scale, "<f4")))
+                new_residuals[path] = err
+            leaves.append((path, qleaf))
     if residuals is not None:
         residuals.clear()
         residuals.update(new_residuals)
@@ -238,7 +270,8 @@ def quantize_tree(
 
 
 def _encode_node(node: Any, table_out: Any, buffers: Buffers,
-                 offset: int) -> int:
+                 offset: int, prefix: Tuple[str, ...] = (),
+                 leaf_versions: Optional[Mapping] = None) -> int:
     """Depth-first walk emitting each leaf's descriptor and buffer in
     lockstep, so decode can recompute offsets from traversal order."""
     if isinstance(node, Mapping):
@@ -253,10 +286,12 @@ def _encode_node(node: Any, table_out: Any, buffers: Buffers,
             child = node[k]
             if isinstance(child, Mapping):
                 entry = {}
-                offset = _encode_node(child, entry, buffers, offset)
+                offset = _encode_node(child, entry, buffers, offset,
+                                      prefix + (k,), leaf_versions)
             else:
                 entry = []
-                offset = _encode_node(child, entry, buffers, offset)
+                offset = _encode_node(child, entry, buffers, offset,
+                                      prefix + (k,), leaf_versions)
             table_out[k] = entry
         return offset
     # Leaf: table_out is the (mutable, empty) descriptor list.
@@ -269,11 +304,18 @@ def _encode_node(node: Any, table_out: Any, buffers: Buffers,
         )
     if isinstance(node, QuantLeaf):
         arr = _wire_array(node.data)
-        table_out.extend([_dtype_str(arr.dtype), list(arr.shape),
-                          {"scale": node.scale, "d": node.dequant_dtype}])
+        quant: Any = {"scale": node.scale, "d": node.dequant_dtype}
     else:
         arr = _wire_array(np.asarray(node))
-        table_out.extend([_dtype_str(arr.dtype), list(arr.shape)])
+        quant = None
+    if leaf_versions is None:
+        # v1 entry: [dtype, shape] (+quant) — byte-stable legacy shape.
+        table_out.extend([_dtype_str(arr.dtype), list(arr.shape)]
+                         + ([quant] if quant is not None else []))
+    else:
+        # v2 entry: [dtype, shape, quant-or-null, leaf_version].
+        table_out.extend([_dtype_str(arr.dtype), list(arr.shape), quant,
+                          int(leaf_versions.get(prefix, -1))])
     if arr.nbytes:
         # A uint8 view flattens any dtype (incl. bfloat16, whose
         # PEP-3118 format memoryview can't export) without copying.
@@ -282,7 +324,8 @@ def _encode_node(node: Any, table_out: Any, buffers: Buffers,
 
 
 def encode(tree_or_leaves: Any, version: int = -1,
-           run_tag: int = 0) -> Buffers:
+           run_tag: int = 0,
+           leaf_versions: Optional[Mapping] = None) -> Buffers:
     """Frame a tree (or pre-flattened/quantized leaves) for the wire.
 
     Returns ``[header+table bytes, buffer, buffer, ...]`` where each
@@ -290,6 +333,11 @@ def encode(tree_or_leaves: Any, version: int = -1,
     bytes are copied here. Write the parts sequentially (sockets and
     ``http.client`` both take iterables) or join with
     :func:`frame_bytes` when one contiguous body is needed.
+
+    ``leaf_versions`` (a ``{path-tuple: int}`` mapping) switches the
+    frame to wire version 2: each leaf entry carries its per-tensor
+    version tag and the tree may be a PARTIAL delta. Leave it None for
+    the byte-stable v1 frames old decoders understand.
     """
     if isinstance(tree_or_leaves, list) and (
         not tree_or_leaves
@@ -303,13 +351,16 @@ def encode(tree_or_leaves: Any, version: int = -1,
     buffers: Buffers = []
     if isinstance(tree, Mapping):
         table: Any = {}
-        payload_len = _encode_node(tree, table, buffers, 0)
+        payload_len = _encode_node(tree, table, buffers, 0, (),
+                                   leaf_versions)
     else:  # single-leaf root
         table = []
-        payload_len = _encode_node(tree, table, buffers, 0)
+        payload_len = _encode_node(tree, table, buffers, 0, (),
+                                   leaf_versions)
 
+    wire_ver = WIRE_VERSION if leaf_versions is None else WIRE_VERSION_DELTA
     table_bytes = json.dumps(table, separators=(",", ":")).encode()
-    header = _HEADER.pack(MAGIC, WIRE_VERSION, 0, int(run_tag) & 0xFFFF,
+    header = _HEADER.pack(MAGIC, wire_ver, 0, int(run_tag) & 0xFFFF,
                           int(version), len(table_bytes), payload_len)
     return [header + table_bytes, *buffers]
 
@@ -338,14 +389,11 @@ def frame_run_tag(data: Union[bytes, bytearray, memoryview]) -> int:
     return int(tag)
 
 
-def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:
-    """``(snapshot_version, tree)`` from a received frame.
-
-    Array leaves are read-only ``np.frombuffer`` views into ``data`` —
-    zero-copy; quantized tensors are dequantized (the one place the
-    bytes are touched). Raises :class:`WireError` on anything
-    malformed or truncated.
-    """
+def _decode_impl(
+    data: Union[bytes, bytearray, memoryview]
+) -> Tuple[int, Any, Dict[Tuple[str, ...], int]]:
+    """Shared v1/v2 decode: ``(version, tree, {path: leaf_version})``
+    (the version map is empty for v1 frames)."""
     mv = memoryview(data)
     if len(mv) < HEADER_SIZE:
         raise WireError(f"frame truncated: {len(mv)} < header {HEADER_SIZE}")
@@ -354,7 +402,7 @@ def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:
     )
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r}")
-    if wire_ver != WIRE_VERSION:
+    if wire_ver not in (WIRE_VERSION, WIRE_VERSION_DELTA):
         raise WireError(f"unsupported wire version {wire_ver}")
     if len(mv) != HEADER_SIZE + table_len + payload_len:
         raise WireError(
@@ -369,8 +417,10 @@ def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:
         raise WireError("tensor table is neither object nor leaf")
 
     payload = mv[HEADER_SIZE + table_len:]
+    leaf_versions: Dict[Tuple[str, ...], int] = {}
 
-    def read_leaf(entry: list, offset: int) -> Tuple[Any, int]:
+    def read_leaf(entry: list, offset: int,
+                  path: Tuple[str, ...]) -> Tuple[Any, int]:
         try:
             dtype = _dtype_of(entry[0])
             shape = tuple(int(d) for d in entry[1])
@@ -381,7 +431,15 @@ def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:
                 # TypeError/KeyError escaping from the math below.
                 quant = (float(quant["scale"]),
                          _dtype_of(quant["d"]).newbyteorder("="))
-        except (IndexError, KeyError, TypeError) as e:
+            if wire_ver == WIRE_VERSION_DELTA:
+                if len(entry) < 4:
+                    raise WireError(
+                        f"delta frame leaf missing version tag: {entry!r}"
+                    )
+                leaf_versions[path] = int(entry[3])
+        except (IndexError, KeyError, TypeError, ValueError) as e:
+            if isinstance(e, WireError):
+                raise
             raise WireError(f"malformed table entry {entry!r}") from e
         if any(d < 0 for d in shape):
             raise WireError(f"negative dim in shape {shape}")
@@ -411,22 +469,55 @@ def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:
             arr = arr.astype(dq) * np.asarray(scale, dtype=dq)
         return arr, offset + nbytes
 
-    def read_node(node: Any, offset: int) -> Tuple[Any, int]:
+    def read_node(node: Any, offset: int,
+                  path: Tuple[str, ...]) -> Tuple[Any, int]:
         if isinstance(node, dict):
             out = {}
             for k, child in node.items():
-                out[k], offset = read_node(child, offset)
+                out[k], offset = read_node(child, offset, path + (k,))
             return out, offset
         if not isinstance(node, list):
             raise WireError(f"malformed table node {node!r}")
-        return read_leaf(node, offset)
+        return read_leaf(node, offset, path)
 
-    tree, consumed = read_node(table, 0)
+    tree, consumed = read_node(table, 0, ())
     if consumed != payload_len:
         raise WireError(
             f"payload length {payload_len} != tensor bytes {consumed}"
         )
-    return int(version), tree
+    return int(version), tree, leaf_versions
+
+
+def decode(data: Union[bytes, bytearray, memoryview]) -> Tuple[int, Any]:
+    """``(snapshot_version, tree)`` from a received frame (v1 or v2 —
+    a v2 frame's per-leaf tags are simply dropped here; use
+    :func:`decode_delta` to keep them).
+
+    Array leaves are read-only ``np.frombuffer`` views into ``data`` —
+    zero-copy; quantized tensors are dequantized (the one place the
+    bytes are touched). Raises :class:`WireError` on anything
+    malformed or truncated.
+    """
+    version, tree, _ = _decode_impl(data)
+    return version, tree
+
+
+def decode_delta(
+    data: Union[bytes, bytearray, memoryview]
+) -> Tuple[int, Dict[Tuple[str, ...], Any], Dict[Tuple[str, ...], int]]:
+    """``(snapshot_version, {path: leaf}, {path: leaf_version})`` from
+    a delta (v2) frame — flat by path, ready to merge into a client's
+    cached tree. Raises :class:`WireError` on a v1 frame (a delta
+    consumer must never silently treat a full snapshot as a delta of
+    everything — though semantically close, the bug it would mask is a
+    server ignoring ``X-Have-Version``)."""
+    mv = memoryview(data)
+    if len(mv) >= HEADER_SIZE:
+        wire_ver = _HEADER.unpack_from(mv, 0)[1]
+        if wire_ver == WIRE_VERSION:
+            raise WireError("expected a delta (v2) frame, got v1")
+    version, tree, vers = _decode_impl(data)
+    return version, dict(flatten_tree(tree)), vers
 
 
 def tree_nbytes(tree: Any) -> int:

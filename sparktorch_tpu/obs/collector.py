@@ -128,7 +128,7 @@ def scrape_json(url: str, timeout: float = _SCRAPE_TIMEOUT) -> Any:
 
 class _RankState:
     __slots__ = ("url", "host", "snapshot", "heartbeats", "last_ok_ts",
-                 "last_error", "scrapes", "errors")
+                 "last_error", "scrapes", "errors", "committed_seq")
 
     def __init__(self, url: str):
         self.url = url.rstrip("/")
@@ -139,6 +139,10 @@ class _RankState:
         self.last_error: Optional[str] = None
         self.scrapes = 0
         self.errors = 0
+        # Sweep generation of the last committed scrape: a straggler
+        # from an OLDER sweep must never overwrite a newer snapshot
+        # (and re-stamp it fresh) after a later sweep already landed.
+        self.committed_seq = -1
 
 
 def _tag_series(flat: str, rank: str, host: str) -> str:
@@ -168,6 +172,8 @@ class FleetCollector:
                  poll_interval_s: float = 2.0,
                  jsonl_path: Optional[str] = None,
                  scrape_timeout_s: float = _SCRAPE_TIMEOUT,
+                 poll_parallelism: int = 8,
+                 poll_deadline_s: Optional[float] = None,
                  host: str = "127.0.0.1", port: int = 0):
         if not targets:
             raise ValueError("FleetCollector needs at least one target")
@@ -179,6 +185,18 @@ class FleetCollector:
         self.poll_interval_s = poll_interval_s
         self.jsonl_path = jsonl_path
         self.scrape_timeout_s = scrape_timeout_s
+        # Fan-in at scale: scrape targets in PARALLEL (a param-server
+        # fleet multiplies targets — N shards + gateway per host; a
+        # serial sweep would take N x timeout when several die at
+        # once) under one sweep-wide deadline budget. poll_parallelism
+        # <= 1 restores the serial sweep.
+        self.poll_parallelism = max(1, int(poll_parallelism))
+        self.poll_deadline_s = (
+            poll_deadline_s if poll_deadline_s is not None
+            else scrape_timeout_s * 2 + 1.0
+        )
+        self._scrape_pool = None
+        self._poll_seq = -1  # sweep generation (stale-commit guard)
         self.host = host
         self.port = port
         self._lock = threading.Lock()
@@ -189,48 +207,121 @@ class FleetCollector:
         self._poll_stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
 
+    @classmethod
+    def for_fleet(cls, fleet, per_shard: bool = False,
+                  **kwargs) -> "FleetCollector":
+        """Collector over a param-server FLEET's scrape surface.
+        Default is the fleet's single deduplicated target (the
+        in-process fleet shares ONE bus across shards — scraping
+        every frontend would multiply every series by the target
+        count; per-shard attribution rides the ``shard`` labels).
+        ``per_shard=True`` targets every shard frontend + gateway —
+        for fleets whose shards own separate buses. ``fleet`` is a
+        :class:`~sparktorch_tpu.serve.fleet.ParamServerFleet` (or
+        anything with ``collector_targets()``)."""
+        kwargs.setdefault("run_id", getattr(
+            getattr(fleet, "telemetry", None), "run_id", None))
+        return cls(fleet.collector_targets(per_shard=per_shard), **kwargs)
+
     # -- scraping ----------------------------------------------------------
 
-    def poll(self) -> Dict[str, Any]:
-        """One sweep over every rank: scrape, tag, merge, sink.
-        Returns the merged snapshot. Per-rank failures degrade to
-        warnings + counters; the sweep itself never raises."""
+    def _scrape_rank(self, rank: str, st: _RankState,
+                     seq: int = -1) -> None:
+        """One target's scrape (telemetry + heartbeats), with the
+        degrade-to-last-good contract. Thread-safe: state lands under
+        the collector lock, so parallel sweeps never tear a rank —
+        and ``seq`` (the sweep generation) gates the commit, so a
+        STRAGGLING scrape from an older sweep that finally answers
+        after a newer sweep landed is dropped, never allowed to roll
+        the rank's snapshot (and its freshness stamp) backwards."""
         tele = self.telemetry
-        for rank, st in self._ranks.items():
-            labels = {"rank": rank}
+        labels = {"rank": rank}
+        try:
+            snap = scrape_json(st.url + "/telemetry",
+                               timeout=self.scrape_timeout_s)
+            if not isinstance(snap, dict):
+                raise ScrapeError(f"{st.url}/telemetry: not an object")
+            hb: Optional[Dict[str, Any]] = None
             try:
-                snap = scrape_json(st.url + "/telemetry",
-                                   timeout=self.scrape_timeout_s)
-                if not isinstance(snap, dict):
-                    raise ScrapeError(f"{st.url}/telemetry: not an object")
-                hb: Optional[Dict[str, Any]] = None
-                try:
-                    got = scrape_json(st.url + "/heartbeats",
-                                      timeout=self.scrape_timeout_s)
-                    hb = got if isinstance(got, dict) else None
-                except ScrapeError:
-                    hb = None  # optional route; /telemetry carries gauges
-                with self._lock:
-                    st.snapshot = snap
-                    if hb is not None:
-                        # Same degrade-to-last-good contract as the
-                        # snapshot: a transient /heartbeats failure
-                        # must not make this target's ranks VANISH
-                        # from /gang — the stale table keeps serving
-                        # (its ages grow, which is the visible signal).
-                        st.heartbeats = hb
-                    st.last_ok_ts = time.time()
-                    st.last_error = None
-                    st.scrapes += 1
-                tele.counter("collector.scrapes_total", labels=labels)
-            except ScrapeError as e:
-                with self._lock:
-                    st.errors += 1
-                    st.last_error = str(e)
-                tele.counter("collector.scrape_errors_total", labels=labels)
+                got = scrape_json(st.url + "/heartbeats",
+                                  timeout=self.scrape_timeout_s)
+                hb = got if isinstance(got, dict) else None
+            except ScrapeError:
+                hb = None  # optional route; /telemetry carries gauges
+            with self._lock:
+                if seq < st.committed_seq:
+                    tele.counter("collector.stale_scrapes_dropped_total",
+                                 labels=labels)
+                    return
+                st.committed_seq = seq
+                st.snapshot = snap
+                if hb is not None:
+                    # Same degrade-to-last-good contract as the
+                    # snapshot: a transient /heartbeats failure
+                    # must not make this target's ranks VANISH
+                    # from /gang — the stale table keeps serving
+                    # (its ages grow, which is the visible signal).
+                    st.heartbeats = hb
+                st.last_ok_ts = time.time()
+                st.last_error = None
+                st.scrapes += 1
+            tele.counter("collector.scrapes_total", labels=labels)
+        except ScrapeError as e:
+            with self._lock:
+                st.errors += 1
+                st.last_error = str(e)
+            tele.counter("collector.scrape_errors_total", labels=labels)
+            _LOG.warning(
+                f"[sparktorch_tpu:collector] rank {rank} scrape "
+                f"failed (serving last good snapshot): {e}"
+            )
+
+    def poll(self) -> Dict[str, Any]:
+        """One sweep over every rank: scrape (in parallel), tag,
+        merge, sink. Returns the merged snapshot. Per-rank failures
+        degrade to warnings + counters; the sweep itself never raises.
+
+        Parallel fan-in: targets scrape concurrently (bounded by
+        ``poll_parallelism``) under the ``poll_deadline_s`` sweep
+        budget, so sweep wall is ~one timeout even when several
+        targets hang — a serial sweep over a fleet's N shard
+        frontends would take N timeouts exactly when things are on
+        fire. A target that misses the sweep deadline is counted
+        (``collector.scrape_deadline_misses_total{rank}``) and its
+        last good snapshot keeps serving; its straggling scrape still
+        lands when it finishes — unless a NEWER sweep already
+        committed for that rank, in which case the stale result is
+        dropped (``collector.stale_scrapes_dropped_total{rank}``)
+        instead of rolling the snapshot backwards."""
+        tele = self.telemetry
+        items = list(self._ranks.items())
+        self._poll_seq += 1
+        seq = self._poll_seq
+        if self.poll_parallelism <= 1 or len(items) == 1:
+            for rank, st in items:
+                self._scrape_rank(rank, st, seq)
+        else:
+            from concurrent.futures import ThreadPoolExecutor, wait
+
+            if self._scrape_pool is None:
+                self._scrape_pool = ThreadPoolExecutor(
+                    max_workers=min(len(items), self.poll_parallelism),
+                    thread_name_prefix="collector-scrape",
+                )
+            futures = {
+                self._scrape_pool.submit(self._scrape_rank, rank, st,
+                                         seq): rank
+                for rank, st in items
+            }
+            _done, not_done = wait(futures, timeout=self.poll_deadline_s)
+            for future in not_done:
+                rank = futures[future]
+                tele.counter("collector.scrape_deadline_misses_total",
+                             labels={"rank": rank})
                 _LOG.warning(
                     f"[sparktorch_tpu:collector] rank {rank} scrape "
-                    f"failed (serving last good snapshot): {e}"
+                    f"missed the {self.poll_deadline_s}s sweep deadline "
+                    f"(serving last good snapshot)"
                 )
         self._merge_xprof()
         merged = self.merged_snapshot()
@@ -478,6 +569,11 @@ class FleetCollector:
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=5.0)
             self._poll_thread = None
+        if self._scrape_pool is not None:
+            # wait=False: a target hung past its socket timeout must
+            # not hold collector shutdown hostage.
+            self._scrape_pool.shutdown(wait=False)
+            self._scrape_pool = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

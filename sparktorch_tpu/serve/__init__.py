@@ -1,3 +1,14 @@
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
 
-__all__ = ["ParameterServer", "ParamServerHttp"]
+__all__ = ["ParameterServer", "ParamServerHttp", "ParamServerFleet",
+           "ParamShardServer"]
+
+
+def __getattr__(name):
+    # Lazy: the fleet pulls in net.sharded + jax; keep the base import
+    # light (and cycle-free) for callers that only want one server.
+    if name in ("ParamServerFleet", "ParamShardServer"):
+        from sparktorch_tpu.serve import fleet
+
+        return getattr(fleet, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,853 @@
+"""Sharded parameter-server fleet with per-tensor delta pulls.
+
+The hogwild topology's scaling bottleneck is ONE process serving
+full-state pulls to every worker (the reference's Flask server on the
+driver, ``server.py:33-149`` — aggregate pull bandwidth capped by a
+single socket loop no matter how many chips train). This module is
+the production shape from Li et al.'s parameter-server work
+(OSDI '14): the tensor tree **hash-partitioned across N server
+shards** by consistent hashing over leaf paths
+(:class:`~sparktorch_tpu.net.sharded.HashRing` — both sides of the
+wire compute the same owner from the shard-id list alone), each shard
+an independent apply loop + HTTP frontend, so pull bandwidth and
+apply throughput scale with shard count.
+
+Per-tensor versioning makes pulls DELTAS: each shard's canonical
+leaves live in a :class:`~sparktorch_tpu.utils.locks.TreeVersionedSlot`
+(a version tag per leaf beside the global version), and the
+``/delta.bin`` route ships only leaves whose tag advanced past the
+client's ``X-Have-Version`` — on a sparse-update workload that is a
+strict subset of the tree every pull. ``X-Pull-Quant: int8`` further
+halves the dominant direction: leaves are served int8 with ONE
+per-(leaf, version) quantization shared by every puller and a
+server-side error-feedback residual folded into the next version's
+quantization (the pull-direction mirror of Lin et al.'s Deep Gradient
+Compression, already proven on the push path).
+
+Live resharding: :meth:`ParamServerFleet.add_shard` and
+:meth:`~ParamServerFleet.drain_shard` move only the consistent-hash
+arcs that changed (~1/N of the leaves) — parameters AND their
+per-leaf optimizer states migrate, the ring version bumps, and
+clients refresh from any shard's ``/fleet.json``. A shard whose
+frontend dies is restarted by the fleet monitor (counted, not fatal);
+clients degrade for a grace window in the meantime
+(:class:`~sparktorch_tpu.net.sharded.ShardedTransport`).
+
+Mixed-version gangs keep working: the fleet's GATEWAY is a stock
+:class:`~sparktorch_tpu.serve.param_server.ParamServerHttp` over a
+facade that assembles the full tree across shards and scatters pushed
+gradients by ring ownership — dill and binary-v1 workers talk to it
+exactly as they talked to the single server.
+
+Optimizer note: shards run the optimizer PER LEAF (each tensor owns
+its optax state), which is exact for element-wise optimizers (sgd,
+adam, rmsprop — everything the registry serves). A transform that
+couples leaves (global-norm clipping) would see per-shard norms
+instead; pick the single server for those.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from sparktorch_tpu.net import wire as binwire
+from sparktorch_tpu.net.sharded import _RING_REPLICAS, HashRing
+from sparktorch_tpu.obs import Telemetry
+from sparktorch_tpu.serve.param_server import (
+    MAX_TOLERATED_ERRORS,
+    ParamServerHttp,
+)
+from sparktorch_tpu.utils.early_stopper import EarlyStopping
+from sparktorch_tpu.utils.locks import TreeVersionedSlot
+from sparktorch_tpu.utils.serde import ModelSpec, deserialize_model
+
+Path = Tuple[str, ...]
+
+
+class ShardStopped(RuntimeError):
+    """Push enqueued on a shard whose writer has exited (drained or
+    failed) — callers must re-route against the current ring instead
+    of waiting out an apply that will never come."""
+
+
+class _LossVote:
+    """The fleet-wide windowed early-stop vote (``server.py:102-123``
+    parity, shared by every shard so the designated vote shard and the
+    gateway agree on one stop decision)."""
+
+    def __init__(self, window_len: int = 3, patience: int = -1):
+        self.window_len = max(1, window_len)
+        self._stopper = (EarlyStopping(patience=patience)
+                         if patience and patience > 0 else None)
+        self._losses: List[float] = []
+        self._stop = False
+        self._lock = threading.Lock()
+
+    def post(self, loss: float) -> bool:
+        with self._lock:
+            if self._stop:
+                return True
+            if self._stopper is None:
+                return False
+            self._losses.append(float(loss))
+            if len(self._losses) >= self.window_len:
+                avg = float(np.mean(self._losses))
+                self._losses.clear()
+                if self._stopper.step(avg):
+                    self._stop = True
+        return self._stop
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop
+
+
+class ParamShardServer:
+    """One fleet shard: the canonical owner of a hash range of leaves.
+
+    Holds its leaves in a :class:`TreeVersionedSlot` (per-leaf version
+    tags → delta pulls), applies gradient partials on a single writer
+    thread through a per-leaf jitted optimizer update, and renders
+    version-2 delta frames with per-version body/quantization caches
+    so a worker swarm pulling the same delta shares one render.
+
+    The object satisfies the :class:`ParamServerHttp` server contract
+    (``slot`` / ``telemetry`` / ``push_gradients`` / ``post_loss``),
+    so a stock HTTP frontend serves it — legacy full-pull routes
+    included (they ship the shard's SUBTREE).
+    """
+
+    def __init__(self, shard_id, leaves: Mapping[Path, Any],
+                 make_tx, device: Optional[jax.Device] = None,
+                 telemetry: Optional[Telemetry] = None,
+                 loss_vote: Optional[_LossVote] = None):
+        self.shard_id = str(shard_id)
+        self.device = device or jax.devices()[0]
+        self.telemetry = telemetry or Telemetry(
+            run_id=f"param_shard_{self.shard_id}"
+        )
+        self._labels = {"shard": self.shard_id}
+        self._loss_vote = loss_vote or _LossVote()
+        self._tx = make_tx()
+        placed = {tuple(p): jax.device_put(v, self.device)
+                  for p, v in leaves.items()}
+        self.slot = TreeVersionedSlot(placed)
+        self._opt: Dict[Path, Any] = {
+            p: jax.device_put(self._tx.init(v), self.device)
+            for p, v in placed.items()
+        }
+
+        def _apply(params, opt_states, grads):
+            """One fused update over a PARTIAL leaf dict: every pushed
+            leaf updates in a single dispatch (a per-leaf loop would
+            cost one GIL-holding dispatch per tensor per push — the
+            apply path must scale with pushes, not leaves). Each leaf
+            still owns its optax state, so the math equals the
+            per-leaf form for element-wise optimizers."""
+            import optax
+
+            grads = {k: g.astype(params[k].dtype) for k, g in grads.items()}
+            new_params: Dict[str, Any] = {}
+            new_opts: Dict[str, Any] = {}
+            for k in grads:
+                updates, new_opts[k] = self._tx.update(
+                    grads[k], opt_states[k], params[k]
+                )
+                new_params[k] = optax.apply_updates(params[k], updates)
+            return new_params, new_opts
+
+        # Jit cache keys on the dict's key-set + shapes: a stable push
+        # pattern (full tree, or a stable sparse subset) compiles once.
+        self._apply_fn = jax.jit(_apply)
+
+        # Render caches (all guarded by _render_lock): host copies per
+        # (path, leaf_version), int8 quantizations per (path, leaf
+        # version) with the shared error-feedback residuals, and whole
+        # delta BODIES per (version, have, quant) — a swarm pulling
+        # the same delta pays one encode.
+        self._render_lock = threading.Lock()
+        self._host_leaves: Dict[Path, Tuple[int, np.ndarray]] = {}
+        self._quant_cache: Dict[Path, Tuple[int, binwire.QuantLeaf]] = {}
+        self._pull_residuals: Dict[Path, np.ndarray] = {}
+        self._bodies: Dict[Tuple, bytes] = {}
+        self._bodies_version: Optional[int] = None
+
+        self._state_lock = threading.Lock()
+        # Serializes the running-check-then-enqueue against stop()'s
+        # drain: without it a push slipping between the check and the
+        # put lands on a queue nobody will ever service and its
+        # wait=True caller sits out the full timeout.
+        self._enqueue_lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._errors = 0
+        self._failed: Optional[BaseException] = None
+        self._applied = 0
+        self._misrouted = 0
+        self._running = True
+        self._writer = threading.Thread(target=self._apply_loop, daemon=True)
+        self._writer.start()
+
+    # -- gradient path -----------------------------------------------------
+
+    def push_gradients(self, grads, wait: bool = True,
+                       timeout: float = 60.0) -> threading.Event:
+        """Enqueue a gradient PARTIAL (nested subtree or ``{path:
+        array}``) for the writer thread; same wait/FIFO semantics as
+        the single server. Returns the apply-completion event either
+        way, so a scatter caller can enqueue on every shard FIRST and
+        wait on the events together (latency = max of shard applies,
+        not their sum)."""
+        if self._failed is not None:
+            raise RuntimeError(
+                f"param shard {self.shard_id} failed"
+            ) from self._failed
+        if isinstance(grads, Mapping) and any(
+            isinstance(k, tuple) for k in grads
+        ):
+            flat = {tuple(p): g for p, g in grads.items()}
+        else:
+            flat = dict(binwire.flatten_tree(grads))
+        done = threading.Event()
+        with self._enqueue_lock:
+            if not self._running:
+                # Fast-fail instead of letting wait=True sit out its
+                # full timeout on a queue nobody drains (the shard was
+                # drained or stopped between the caller's ring
+                # snapshot and now). Checked under the enqueue lock so
+                # a put can never slip past stop()'s final drain.
+                raise ShardStopped(
+                    f"param shard {self.shard_id} is stopped"
+                )
+            self._queue.put((flat, done))
+        self.telemetry.counter("param_server.pushes", labels=self._labels)
+        if wait and not done.wait(timeout):
+            raise TimeoutError(
+                f"param shard {self.shard_id} apply timed out"
+            )
+        return done
+
+    def _apply_loop(self) -> None:
+        while self._running:
+            try:
+                flat, done = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                t0 = time.perf_counter()
+                with self._state_lock:
+                    _version, params, _vers = self.slot.read_leaves()
+                    owned: Dict[str, Path] = {}
+                    grads: Dict[str, Any] = {}
+                    for path, grad in flat.items():
+                        if path not in params:
+                            # A partial routed by a stale ring (leaf
+                            # moved by add/drain): dropped + counted,
+                            # the client's next ring refresh fixes it.
+                            self._misrouted += 1
+                            self.telemetry.counter(
+                                "fleet.misrouted_leaves_total",
+                                labels=self._labels)
+                            continue
+                        key = "/".join(path)
+                        owned[key] = path
+                        grads[key] = jax.device_put(np.asarray(grad),
+                                                    self.device)
+                    if owned:
+                        new_params, new_opts = self._apply_fn(
+                            {k: params[p] for k, p in owned.items()},
+                            {k: self._opt[p] for k, p in owned.items()},
+                            grads,
+                        )
+                        for key, path in owned.items():
+                            self._opt[path] = new_opts[key]
+                        self.slot.swap_leaves(
+                            {path: new_params[key]
+                             for key, path in owned.items()}
+                        )
+                        self._applied += 1
+                        self.telemetry.counter("param_server.applies",
+                                               labels=self._labels)
+                self.telemetry.observe("param_server.apply_s",
+                                       time.perf_counter() - t0,
+                                       labels=self._labels)
+                self.telemetry.gauge("param_server.version",
+                                     self.slot.version, labels=self._labels)
+            except Exception as e:
+                self._errors += 1
+                self.telemetry.counter("param_server.apply_errors",
+                                       labels=self._labels)
+                if self._errors > MAX_TOLERATED_ERRORS:
+                    self._failed = e
+                    self._running = False
+            finally:
+                if done is not None:
+                    done.set()
+                self._queue.task_done()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self._queue.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    @property
+    def applied_updates(self) -> int:
+        return self._applied
+
+    # -- delta rendering ---------------------------------------------------
+
+    def render_delta(self, have_version: int, quant: Optional[str] = None,
+                     run_tag: int = 0) -> Tuple[int, Optional[bytes]]:
+        """``(version, body)`` — a v2 delta frame of every leaf whose
+        version advanced past ``have_version``; ``(version, None)``
+        when the client is up to date (the route's 304).
+
+        ``quant='int8'`` serves int8 leaves with server-side error
+        feedback: each (leaf, version) is quantized ONCE — every
+        client pulling that version gets identical bytes and the
+        residual is consumed exactly once — and the residual is added
+        before quantizing the leaf's next version, so compression
+        noise averages out across served versions instead of
+        accumulating as bias.
+        """
+        if quant not in (None, "", "int8"):
+            raise ValueError(f"pull quant {quant!r}; use int8 or nothing")
+        have = int(have_version)
+        self.telemetry.counter("fleet.delta_pulls", labels=self._labels)
+        delta = self.slot.read_delta(have)
+        if delta is None:
+            return self.slot.version, None
+        version, entries = delta
+        # Cache key = the (path, leaf_version) SET the delta contains,
+        # not the client's raw have-version: a swarm whose members sit
+        # at different have values usually selects the SAME leaf set,
+        # and must share one render (the single server shares one body
+        # per version; the fleet must not regress to per-client
+        # encodes under swarm load).
+        key = (version, quant or "",
+               tuple(sorted((p, v) for p, _, v in entries)))
+        with self._render_lock:
+            if self._bodies_version != version or len(self._bodies) > 64:
+                self._bodies.clear()
+                self._bodies_version = version
+            body = self._bodies.get(key)
+            if body is not None:
+                return version, body
+            leaves: List[Tuple[Path, Any]] = []
+            leaf_versions: Dict[Path, int] = {}
+            for path, leaf, lver in entries:
+                cached = self._host_leaves.get(path)
+                if cached is None or cached[0] != lver:
+                    arr = np.asarray(leaf)
+                    self._host_leaves[path] = (lver, arr)
+                else:
+                    arr = cached[1]
+                if quant == "int8" and binwire._is_float(arr) and arr.size:
+                    qc = self._quant_cache.get(path)
+                    if qc is None or qc[0] != lver:
+                        qleaf, residual = binwire.quantize_leaf_int8(
+                            arr, self._pull_residuals.get(path)
+                        )
+                        self._pull_residuals[path] = residual
+                        self._quant_cache[path] = (lver, qleaf)
+                    else:
+                        qleaf = qc[1]
+                    leaves.append((path, qleaf))
+                else:
+                    leaves.append((path, arr))
+                leaf_versions[path] = lver
+            body = binwire.frame_bytes(binwire.encode(
+                leaves, version=version, run_tag=run_tag,
+                leaf_versions=leaf_versions,
+            ))
+            self._bodies[key] = body
+            self.telemetry.counter("fleet.delta_renders",
+                                   labels=self._labels)
+            return version, body
+
+    # -- live resharding ---------------------------------------------------
+
+    def extract(self, paths) -> Dict[Path, Dict[str, Any]]:
+        """Atomically remove ``paths`` (params + their optimizer
+        states) for migration to another shard. The writer thread
+        can't interleave: it applies under the same state lock."""
+        with self._state_lock, self._render_lock:
+            removed = self.slot.remove_leaves(paths)
+            out: Dict[Path, Dict[str, Any]] = {}
+            for path, leaf in removed.items():
+                out[path] = {"param": leaf, "opt": self._opt.pop(path, None)}
+                self._host_leaves.pop(path, None)
+                self._quant_cache.pop(path, None)
+                self._pull_residuals.pop(path, None)
+            self._bodies.clear()
+            self._bodies_version = None
+            return out
+
+    def install(self, entries: Mapping[Path, Mapping[str, Any]]) -> None:
+        """Adopt migrated leaves: params + optimizer states land on
+        this shard's device, stamped with a fresh version so every
+        delta client picks them up on its next pull."""
+        if not entries:
+            return
+        with self._state_lock:
+            new_leaves: Dict[Path, Any] = {}
+            for path, entry in entries.items():
+                path = tuple(path)
+                param = jax.device_put(entry["param"], self.device)
+                opt = entry.get("opt")
+                self._opt[path] = (
+                    jax.device_put(opt, self.device)
+                    if opt is not None else self._tx.init(param)
+                )
+                new_leaves[path] = param
+            self.slot.swap_leaves(new_leaves)
+
+    # -- early stopping / lifecycle ----------------------------------------
+
+    def post_loss(self, loss: float) -> bool:
+        self.telemetry.counter("param_server.losses_posted",
+                               labels=self._labels)
+        return self._loss_vote.post(loss)
+
+    @property
+    def should_stop(self) -> bool:
+        return self._loss_vote.should_stop
+
+    def stop(self) -> None:
+        self._running = False
+        if self._writer.is_alive():
+            self._writer.join(timeout=5.0)
+        # Release any pusher that enqueued before the flag flipped:
+        # its gradient is lost (the shard is gone), but a wait=True
+        # caller must not sit out its full timeout on an unserviced
+        # event. Under the enqueue lock, so no put can land AFTER this
+        # drain (push_gradients re-checks _running under the same
+        # lock and fast-fails).
+        with self._enqueue_lock:
+            while True:
+                try:
+                    _flat, done = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if done is not None:
+                    done.set()
+                self._queue.task_done()
+
+
+# ---------------------------------------------------------------------------
+# Gateway facade: the single-server wire over the whole fleet
+# ---------------------------------------------------------------------------
+
+
+class _CompositeSlot:
+    """A read-only VersionedSlot view assembling the full tree across
+    shards. The composite version is the SUM of shard versions plus
+    the fleet's drain offset — monotonic through applies, adds, and
+    drains, so legacy ``X-Have-Version`` 204/304 semantics hold."""
+
+    def __init__(self, fleet: "ParamServerFleet"):
+        self._fleet = fleet
+        self.epoch = None  # gateway serves no delta route
+
+    def read(self) -> Tuple[int, Any]:
+        # Under the topology lock: mid-drain, the offset and the shard
+        # map change in two steps, and reading between them would
+        # double-count the drained shard's versions — a legacy client
+        # would store the inflated value as its have-version and then
+        # 304 through the next V real updates. Contention is only
+        # against add/drain (rare); applies never hold this lock.
+        with self._fleet._topology_lock:
+            version = self._fleet._version_offset
+            flat: Dict[Path, Any] = {}
+            for shard in self._fleet._shards.values():
+                v, leaves, _ = shard.slot.read_leaves()
+                version += v
+                flat.update(leaves)
+        return version, binwire.unflatten_tree(list(flat.items()))
+
+    @property
+    def version(self) -> int:
+        with self._fleet._topology_lock:
+            return self._fleet._version_offset + sum(
+                s.slot.version for s in self._fleet._shards.values()
+            )
+
+
+class _GatewayFacade:
+    """Duck-types the :class:`ParameterServer` surface
+    :class:`ParamServerHttp` serves, backed by the whole fleet:
+    pulls assemble, pushes scatter by ring ownership."""
+
+    def __init__(self, fleet: "ParamServerFleet"):
+        self._fleet = fleet
+        self.slot = _CompositeSlot(fleet)
+        self.telemetry = fleet.telemetry
+
+    def push_gradients(self, grads, wait: bool = True,
+                       timeout: float = 60.0) -> None:
+        self._fleet.scatter_push(grads, wait=wait, timeout=timeout)
+
+    def post_loss(self, loss: float) -> bool:
+        return self._fleet.post_loss(loss)
+
+
+# ---------------------------------------------------------------------------
+# The fleet
+# ---------------------------------------------------------------------------
+
+
+class ParamServerFleet:
+    """N param-server shards + gateway + restart monitor, presented
+    through the same driver-side surface as :class:`ParameterServer`
+    (``model_state`` / ``final_state`` / ``should_stop`` /
+    ``applied_updates`` / ``stop``), so ``train_async(shards=N)``
+    swaps it in without touching the worker loop.
+    """
+
+    def __init__(self, torch_obj, n_shards: int = 2,
+                 window_len: int = 3, early_stop_patience: int = -1,
+                 seed: int = 0, telemetry: Optional[Telemetry] = None,
+                 devices: Optional[List[jax.Device]] = None,
+                 ring_replicas: int = _RING_REPLICAS,
+                 restart_shards: bool = True):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        self.spec: ModelSpec = deserialize_model(torch_obj)
+        self.telemetry = telemetry or Telemetry(run_id="param_fleet")
+        self._devices = list(devices or jax.devices())
+        self._loss_vote = _LossVote(window_len, early_stop_patience)
+        self.restart_shards = restart_shards
+
+        # One deterministic init (same contract as ParameterServer:
+        # the server owns the canonical init), then partition the leaf
+        # paths across the ring.
+        rng = jax.random.key(seed)
+        variables = dict(self.spec.init_params(rng))
+        params = variables.pop("params", variables)
+        self._model_state = variables
+        flat = dict(binwire.flatten_tree(
+            jax.tree.map(lambda a: np.asarray(a), params)
+        ))
+
+        self.ring = HashRing(range(n_shards), replicas=ring_replicas)
+        self.ring_version = 1
+        self._version_offset = 0  # keeps the gateway version monotonic
+        # across drains (a drained shard's versions leave the sum)
+        assignment = self.ring.assignment(flat)
+        self._shards: Dict[str, ParamShardServer] = {}
+        for i, sid in enumerate(self.ring.shard_ids):
+            self._shards[sid] = ParamShardServer(
+                sid, {p: flat[p] for p in assignment[sid]},
+                make_tx=self.spec.make_optimizer,
+                device=self._devices[i % len(self._devices)],
+                telemetry=self.telemetry, loss_vote=self._loss_vote,
+            )
+        self.telemetry.gauge("fleet.shards", len(self._shards))
+
+        self._https: Dict[str, ParamServerHttp] = {}
+        self._gateway: Optional[ParamServerHttp] = None
+        self._desired: set = set()
+        self._death_noticed: Dict[str, float] = {}
+        self._topology_lock = threading.RLock()
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._host = "127.0.0.1"
+
+    # -- topology ----------------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        """The ``/fleet.json`` document clients build their ring
+        from — served by every shard and the gateway. Under the
+        topology lock (reentrant): it is called from handler threads
+        mid-add/drain, and a torn read would pair the old ring
+        version with the new shard map (or die iterating a mutating
+        dict)."""
+        with self._topology_lock:
+            return {
+                "run_id": self.telemetry.run_id,
+                "ring_version": self.ring_version,
+                "replicas": self.ring.replicas,
+                "shards": self.urls(),
+                "gateway": self._gateway.url if self._gateway else None,
+            }
+
+    def urls(self) -> Dict[str, str]:
+        with self._topology_lock:
+            return {sid: http.url for sid, http in self._https.items()}
+
+    @property
+    def gateway_url(self) -> str:
+        if self._gateway is None:
+            raise RuntimeError("fleet not started")
+        return self._gateway.url
+
+    def collector_targets(self, per_shard: bool = False) -> Dict[str, str]:
+        """Fleet-aware :class:`~sparktorch_tpu.obs.collector.
+        FleetCollector` targets.
+
+        Default: ONE target (the gateway, falling back to the first
+        shard) — this in-process fleet runs every shard on the SAME
+        telemetry bus, so every frontend serves the identical
+        snapshot and scraping each would duplicate every series once
+        per target in the merged view (per-shard attribution already
+        rides the series' own ``shard`` labels). ``per_shard=True``
+        opts into one target per frontend — the right shape once
+        shards are separate processes with their own buses (ROADMAP
+        follow-up)."""
+        with self._topology_lock:
+            if per_shard:
+                targets = {f"shard{sid}": url
+                           for sid, url in self.urls().items()}
+                if self._gateway is not None:
+                    targets["gateway"] = self._gateway.url
+                return targets
+            if self._gateway is not None:
+                return {"fleet": self._gateway.url}
+            urls = self.urls()
+            sid = sorted(urls)[0]
+            return {"fleet": urls[sid]}
+
+    def _start_shard_http(self, sid: str, port: int = 0) -> ParamServerHttp:
+        return ParamServerHttp(
+            self._shards[sid], host=self._host, port=port, shard=sid,
+            extra_json_routes={"/fleet.json": self.describe},
+            ring_version_fn=lambda: self.ring_version,
+        ).start()
+
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              gateway: bool = True) -> "ParamServerFleet":
+        """Start every shard frontend (ephemeral ports), the legacy
+        gateway on ``port``, and the restart monitor."""
+        self._host = host
+        with self._topology_lock:
+            for sid in self.ring.shard_ids:
+                if sid not in self._https:
+                    self._https[sid] = self._start_shard_http(sid)
+                    self._desired.add(sid)
+            if gateway and self._gateway is None:
+                self._gateway = ParamServerHttp(
+                    _GatewayFacade(self), host=host, port=port,
+                    extra_json_routes={"/fleet.json": self.describe},
+                    ring_version_fn=lambda: self.ring_version,
+                ).start()
+        if self.restart_shards and self._monitor is None:
+            self._monitor_stop.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="fleet-monitor",
+            )
+            self._monitor.start()
+        return self
+
+    def _monitor_loop(self) -> None:
+        """Shard-death degradation: a dead shard FRONTEND (chaos kill,
+        handler crash) is restarted on its old port — counted in
+        ``fleet.shard_restarts_total`` and timed in
+        ``fleet.shard_recovery_latency_s`` — well inside the clients'
+        grace window, so a seeded kill costs staleness, never the
+        run."""
+        while not self._monitor_stop.wait(0.05):
+            with self._topology_lock:
+                dead = [
+                    (sid, http) for sid, http in self._https.items()
+                    if sid in self._desired and http._httpd is None
+                ]
+            for sid, http in dead:
+                now = time.monotonic()
+                self._death_noticed.setdefault(sid, now)
+                try:
+                    new = self._start_shard_http(sid, port=http.port)
+                except OSError:
+                    continue  # port in TIME_WAIT; retry next tick
+                with self._topology_lock:
+                    if sid in self._desired:
+                        self._https[sid] = new
+                        self.telemetry.counter(
+                            "fleet.shard_restarts_total",
+                            labels={"shard": sid})
+                        self.telemetry.observe(
+                            "fleet.shard_recovery_latency_s",
+                            time.monotonic()
+                            - self._death_noticed.pop(sid))
+                    else:
+                        new.stop()  # drained while restarting
+
+    def kill_shard(self, shard_id) -> None:
+        """Take one shard's HTTP frontend down WITHOUT draining it —
+        the fault-injection surface (`ft.chaos` uses the same path via
+        the ``fleet.shard`` site). The monitor restarts it."""
+        self._https[str(shard_id)].stop()
+
+    def add_shard(self, device: Optional[jax.Device] = None) -> str:
+        """Grow the ring live: a new shard joins, and ONLY the leaves
+        whose consistent-hash arc moved migrate to it (params +
+        optimizer state). Returns the new shard id."""
+        with self._topology_lock:
+            sid = str(max((int(s) for s in self._shards), default=-1) + 1)
+            shard = ParamShardServer(
+                sid, {}, make_tx=self.spec.make_optimizer,
+                device=device or self._devices[
+                    len(self._shards) % len(self._devices)],
+                telemetry=self.telemetry, loss_vote=self._loss_vote,
+            )
+            self.ring.add(sid)
+            moved: Dict[Path, Dict[str, Any]] = {}
+            for other in self._shards.values():
+                other.drain()
+                mine = [p for p in other.slot.paths
+                        if self.ring.owner(p) == sid]
+                if mine:
+                    moved.update(other.extract(mine))
+            shard.install(moved)
+            self._shards[sid] = shard
+            if self._https:  # started fleet: serve the new shard now
+                self._https[sid] = self._start_shard_http(sid)
+                self._desired.add(sid)
+            self.ring_version += 1
+            self.telemetry.gauge("fleet.shards", len(self._shards))
+            self.telemetry.counter("fleet.reshards_total",
+                                   labels={"op": "add"})
+            self.telemetry.counter("fleet.leaves_moved_total", len(moved),
+                                   labels={"op": "add"})
+            return sid
+
+    def drain_shard(self, shard_id) -> int:
+        """Shrink the ring live: the shard's leaves (params +
+        optimizer states) migrate to their new consistent-hash owners,
+        then the shard stops. Returns the number of leaves moved."""
+        sid = str(shard_id)
+        with self._topology_lock:
+            if len(self._shards) <= 1:
+                raise ValueError("cannot drain the last shard")
+            shard = self._shards[sid]
+            self.ring.remove(sid)
+            self._desired.discard(sid)
+            shard.drain()
+            entries = shard.extract(shard.slot.paths)
+            groups: Dict[str, Dict[Path, Any]] = {}
+            for path, entry in entries.items():
+                groups.setdefault(self.ring.owner(path), {})[path] = entry
+            for target_sid, part in groups.items():
+                self._shards[target_sid].install(part)
+            # Keep the gateway's composite version monotonic: the
+            # drained shard's count leaves the sum for good.
+            self._version_offset += shard.slot.version
+            http = self._https.pop(sid, None)
+            if http is not None:
+                http.stop()
+            del self._shards[sid]
+            shard.stop()
+            self.ring_version += 1
+            self.telemetry.gauge("fleet.shards", len(self._shards))
+            self.telemetry.counter("fleet.reshards_total",
+                                   labels={"op": "drain"})
+            self.telemetry.counter("fleet.leaves_moved_total",
+                                   len(entries), labels={"op": "drain"})
+            return len(entries)
+
+    # -- driver-side ParameterServer surface -------------------------------
+
+    def scatter_push(self, grads, wait: bool = True,
+                     timeout: float = 60.0) -> None:
+        """Split a gradient tree (nested, or flat ``{path: array}`` —
+        partials welcome) by ring ownership and push each piece to its
+        shard (the gateway's legacy-push path). A shard drained
+        between the ring snapshot and the push fast-fails with
+        :class:`ShardStopped`; the partial re-routes once against the
+        refreshed ring (its leaves moved with the drain)."""
+        if isinstance(grads, Mapping) and any(
+            isinstance(k, tuple) for k in grads
+        ):
+            flat = {tuple(p): g for p, g in grads.items()}
+        else:
+            flat = dict(binwire.flatten_tree(grads))
+        pending = set(flat)
+        events: List[Tuple[str, threading.Event]] = []
+        for attempt in range(2):
+            with self._topology_lock:
+                groups = self.ring.assignment(pending)
+                shards = dict(self._shards)
+            try:
+                # Two-phase: ENQUEUE on every shard first (the applies
+                # run in parallel on the shard writer threads), wait
+                # after — one scatter costs the slowest shard's apply,
+                # not the sum of all of them.
+                for sid, paths in groups.items():
+                    if paths:
+                        events.append((sid, shards[sid].push_gradients(
+                            {p: flat[p] for p in paths}, wait=False,
+                            timeout=timeout,
+                        )))
+                        # Only landed partials leave the retry set — a
+                        # blind full retry would double-apply on the
+                        # shards that already took theirs.
+                        pending.difference_update(paths)
+                break
+            except ShardStopped:
+                if attempt:
+                    raise
+                self.telemetry.counter("fleet.push_reroutes_total")
+        if wait:
+            deadline = time.monotonic() + timeout
+            for sid, event in events:
+                if not event.wait(max(0.0, deadline - time.monotonic())):
+                    raise TimeoutError(
+                        f"param shard {sid} apply timed out"
+                    )
+
+    def post_loss(self, loss: float) -> bool:
+        return self._loss_vote.post(loss)
+
+    @property
+    def should_stop(self) -> bool:
+        return self._loss_vote.should_stop
+
+    @property
+    def applied_updates(self) -> int:
+        with self._topology_lock:
+            return sum(s.applied_updates for s in self._shards.values())
+
+    def model_state(self):
+        return self._model_state
+
+    def drain(self, timeout: float = 30.0) -> None:
+        with self._topology_lock:
+            shards = list(self._shards.values())
+        for shard in shards:
+            shard.drain(timeout=timeout)
+
+    def assemble(self) -> Any:
+        """The full parameter tree across every shard (leaves stay on
+        their shard devices)."""
+        with self._topology_lock:
+            shards = list(self._shards.values())
+        flat: Dict[Path, Any] = {}
+        for shard in shards:
+            _v, leaves, _vers = shard.slot.read_leaves()
+            flat.update(leaves)
+        return binwire.unflatten_tree(list(flat.items()))
+
+    def final_state(self):
+        self.drain()
+        return self.assemble(), self._model_state
+
+    def stop(self) -> None:
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        with self._topology_lock:
+            self._desired.clear()
+            for http in self._https.values():
+                http.stop()
+            self._https.clear()
+            if self._gateway is not None:
+                self._gateway.stop()
+                self._gateway = None
+        for shard in self._shards.values():
+            shard.stop()

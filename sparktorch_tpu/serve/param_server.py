@@ -331,13 +331,31 @@ class ParamServerHttp:
     and ``GET /telemetry`` the same snapshot as JSON — both rendered
     from ONE ``Telemetry.snapshot()``, so a scrape can never disagree
     with the JSONL dump of the same server.
+
+    Fleet mode (:mod:`sparktorch_tpu.serve.fleet`): when the backing
+    server exposes ``render_delta`` (a :class:`ParamShardServer`),
+    ``GET /delta.bin`` serves per-tensor delta frames — only the
+    leaves whose version advanced past the client's
+    ``X-Have-Version``, optionally int8-quantized with server-side
+    error feedback (``X-Pull-Quant: int8``). Every delta reply (304
+    included) carries ``X-Slot-Epoch`` (the slot's boot nonce — a
+    restarted/rebuilt server is detected by epoch change, never by
+    version arithmetic) and, when ``ring_version_fn`` is given,
+    ``X-Ring-Version`` so clients learn about shard add/drain without
+    polling. ``shard`` labels every wire metric series with the shard
+    id, and ``extra_json_routes`` mounts small JSON control routes
+    (the fleet's ``/fleet.json`` topology document).
     """
 
     def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
-                 port: int = 3000):
+                 port: int = 3000, shard: Optional[str] = None,
+                 extra_json_routes=None, ring_version_fn=None):
         self.server = server
         self.host = host
         self.port = port
+        self.shard = str(shard) if shard is not None else None
+        self.extra_json_routes = dict(extra_json_routes or {})
+        self.ring_version_fn = ring_version_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -389,15 +407,43 @@ class ParamServerHttp:
                         )
                 return version, wire_cache[fmt]
 
+        psh = self
+        shard_label = self.shard
+        extra_json = self.extra_json_routes
+        ring_version_fn = self.ring_version_fn
+
         def _record_wire(route: str, direction: str, nbytes: int,
                          seconds: float) -> None:
             """Per-route byte/latency accounting on the bus: the
             `/metrics` series the ISSUE names (wire_bytes_total plus a
-            push/pull latency histogram per route)."""
+            push/pull latency histogram per route). Fleet shards add
+            a ``shard`` label so the per-shard series never alias."""
+            labels = {"route": route, "dir": direction}
+            hist_labels = {"route": route}
+            if shard_label is not None:
+                labels["shard"] = shard_label
+                hist_labels["shard"] = shard_label
             ps.telemetry.counter("param_server.wire_bytes_total", nbytes,
-                                 labels={"route": route, "dir": direction})
+                                 labels=labels)
             ps.telemetry.observe("param_server.wire_latency_s", seconds,
-                                 labels={"route": route})
+                                 labels=hist_labels)
+
+        def _fire_shard_chaos(handler, route: str) -> bool:
+            """The fleet's seeded shard-kill site: a chaos config can
+            take THIS shard's HTTP frontend down at its Nth request.
+            Returns True when the request must be aborted (connection
+            dropped, no reply — exactly what a dying shard looks
+            like from the client side)."""
+            if shard_label is None:
+                return False
+            act = _chaos.fire("fleet.shard", shard=shard_label, route=route)
+            if act and act.get("die"):
+                # stop() from a separate thread: it joins handler
+                # machinery this very thread is part of.
+                threading.Thread(target=psh.stop, daemon=True).start()
+                handler.close_connection = True
+                return True
+            return False
 
         class Handler(BaseHTTPRequestHandler):
             # Keep-alive: binary transports hold ONE connection for a
@@ -408,19 +454,72 @@ class ParamServerHttp:
                 pass  # (server.py:28-30 parity)
 
             def _send(self, code: int, body: bytes = b"",
-                      content_type: Optional[str] = None):
+                      content_type: Optional[str] = None,
+                      extra_headers=None):
                 self.send_response(code)
                 if content_type:
                     self.send_header("Content-Type", content_type)
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, str(v))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 if body:
                     self.wfile.write(body)
 
+            def _delta_headers(self) -> dict:
+                """Resync metadata on EVERY delta reply (304 too): the
+                slot epoch catches rebuilt server state, the ring
+                version catches shard add/drain."""
+                out = {}
+                epoch = getattr(ps.slot, "epoch", None)
+                if epoch is not None:
+                    out["X-Slot-Epoch"] = str(int(epoch))
+                if ring_version_fn is not None:
+                    out["X-Ring-Version"] = str(int(ring_version_fn()))
+                return out
+
             def do_GET(self):
                 route = self.path.split("?", 1)[0]
+                if _fire_shard_chaos(self, route):
+                    return
                 ps.telemetry.counter("param_server.http_requests",
                                      labels={"route": route})
+                if route == "/delta.bin" \
+                        and hasattr(ps, "render_delta"):
+                    t0 = time.perf_counter()
+                    have = int(self.headers.get("X-Have-Version", "-1"))
+                    quant = self.headers.get("X-Pull-Quant") or None
+                    try:
+                        _version, body = ps.render_delta(
+                            have, quant=quant, run_tag=server_tag
+                        )
+                    except ValueError:
+                        self._send(400)
+                        return
+                    hdrs = self._delta_headers()
+                    if body is None:
+                        self._send(304, extra_headers=hdrs)
+                        _record_wire(route, "tx", 0,
+                                     time.perf_counter() - t0)
+                        return
+                    act = _chaos.fire("param_server.pull", route=route)
+                    if act and act.get("truncate"):
+                        body = body[: max(1, len(body) // 2)]
+                    self._send(200, body,
+                               content_type=binwire.CONTENT_TYPE,
+                               extra_headers=hdrs)
+                    _record_wire(route, "tx", len(body),
+                                 time.perf_counter() - t0)
+                    return
+                if route in extra_json:
+                    try:
+                        doc = extra_json[route]()
+                    except Exception:
+                        self._send(500)
+                        return
+                    self._send(200, json.dumps(doc).encode(),
+                               content_type="application/json")
+                    return
                 if route == "/":
                     self._send(200, b"sparktorch-tpu parameter server")
                 elif route in ("/parameters", "/parameters.bin"):
@@ -466,6 +565,8 @@ class ParamServerHttp:
                 # raw paths would split one route across series and
                 # let a client grow label cardinality without bound.
                 route = self.path.split("?", 1)[0]
+                if _fire_shard_chaos(self, route):
+                    return
                 ps.telemetry.counter("param_server.http_requests",
                                      labels={"route": route})
                 length = int(self.headers.get("Content-Length", "0"))

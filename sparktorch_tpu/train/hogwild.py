@@ -496,6 +496,8 @@ def train_async(
     compress: bool = True,
     wire: str = "binary",
     quant: Optional[str] = None,
+    shards: int = 1,
+    pull_quant: Optional[str] = None,
     telemetry=None,
     profile_dir: Optional[str] = None,
     supervise: bool = False,
@@ -521,6 +523,17 @@ def train_async(
     residuals; ``compress=False`` ships full-precision pushes on
     either wire.
 
+    ``shards=N`` (with ``transport='http'``) replaces the single
+    parameter server with an N-shard fleet
+    (:class:`~sparktorch_tpu.serve.fleet.ParamServerFleet`): the
+    tensor tree consistent-hashed across N shard servers, workers on
+    :class:`~sparktorch_tpu.net.sharded.ShardedTransport` fanning
+    per-tensor DELTA pulls and scattered pushes across them.
+    ``pull_quant='int8'`` additionally serves int8 pulls with
+    server-side error feedback. ``wire='dill'`` with ``shards=N``
+    keeps legacy workers working through the fleet's gateway (the
+    mixed-version-gang story).
+
     ``supervise=True`` (or any ``ft_policy``) runs the workers under
     the fault-tolerance supervisor (:mod:`sparktorch_tpu.ft`): a dead
     worker is restarted with exponential backoff + jitter under the
@@ -545,21 +558,75 @@ def train_async(
     devices = jax.devices()
     n_workers = partitions if partitions and partitions > 0 else len(devices)
 
+    if shards and shards > 1 and transport != "http":
+        raise ValueError("shards>1 requires transport='http' (the fleet "
+                         "is an HTTP tier; local workers need no fleet)")
     # The server records into the SAME run-scoped bus as the workers,
     # so one /metrics scrape (or JSONL dump) tells the whole async
     # story: pulls/pushes/applies next to worker iters and phase times.
-    server = ParameterServer(
-        spec,
-        window_len=n_workers,  # torch_distributed.py:315-322 parity
-        early_stop_patience=early_stop_patience,
-        acquire_lock=acquire_lock,
-        seed=seed,
-        telemetry=tele,
-    )
+    def _restart_counter_total() -> float:
+        return sum(
+            v for k, v in tele.snapshot().get("counters", {}).items()
+            if k.startswith("fleet.shard_restarts_total")
+        )
+
+    fleet = None
+    restarts_baseline = 0.0
+    if shards and shards > 1:
+        from sparktorch_tpu.serve.fleet import ParamServerFleet
+
+        # Counters on a shared bus are monotonic across runs; snapshot
+        # the baseline so this run's summary reports ITS restarts, not
+        # every prior run's on the same process-global bus.
+        restarts_baseline = _restart_counter_total()
+        server = fleet = ParamServerFleet(
+            spec, n_shards=shards,
+            window_len=n_workers,  # torch_distributed.py:315-322 parity
+            early_stop_patience=early_stop_patience,
+            seed=seed, telemetry=tele,
+        )
+    else:
+        server = ParameterServer(
+            spec,
+            window_len=n_workers,  # torch_distributed.py:315-322 parity
+            early_stop_patience=early_stop_patience,
+            acquire_lock=acquire_lock,
+            seed=seed,
+            telemetry=tele,
+        )
     http: Optional[ParamServerHttp] = None
     profiler = None
+    worker_transports: List[Any] = []
     try:
-        if transport == "http":
+        if transport == "http" and fleet is not None:
+            fleet.start(port=port)
+            grace_s = float(getattr(ft_policy, "rejoin_grace_s", 30.0)
+                            or 30.0)
+            if wire == "dill":
+                # Legacy workers keep training through the fleet's
+                # gateway — the mixed-version-gang contract.
+                worker_transports = [
+                    HttpTransport(fleet.gateway_url, compress=compress)
+                    for _ in range(n_workers)
+                ]
+            elif wire == "binary":
+                from sparktorch_tpu.net.sharded import ShardedTransport
+
+                push_quant = quant if quant else ("bf16" if compress
+                                                  else None)
+                worker_transports = [
+                    ShardedTransport(fleet, quant=push_quant,
+                                     pull_quant=pull_quant,
+                                     grace_s=grace_s,
+                                     telemetry=tele, run_id=tele.run_id)
+                    for _ in range(n_workers)
+                ]
+            else:
+                raise ValueError(
+                    f"unknown wire {wire!r}; use 'binary' or 'dill'"
+                )
+            assert worker_transports[0].alive()  # liveness gate
+        elif transport == "http":
             http = ParamServerHttp(server, port=port).start()
             if wire == "dill":
                 worker_transports = [
@@ -731,6 +798,14 @@ def train_async(
                 "hogwild_budget": tot,
                 "server_applied": server.applied_updates,
             }
+        if fleet is not None:
+            summary = dict(summary or {})
+            summary["fleet"] = {
+                "shards": len(fleet.urls()),
+                "ring_version": fleet.ring_version,
+                "shard_restarts": int(_restart_counter_total()
+                                      - restarts_baseline),
+            }
         if ft_summaries:
             summary = dict(summary or {})
             summary["ft"] = {
@@ -748,6 +823,15 @@ def train_async(
         if profiler is not None:
             profiler.__exit__(None, None, None)
         # Stop server even on failure (hogwild.py:184-186 parity).
+        # Transports first: a ShardedTransport owns connections (and
+        # possibly a fan-out pool) that must not outlive the run.
+        for transport in worker_transports:
+            close = getattr(transport, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except OSError:
+                    pass
         if http is not None:
             http.stop()
         server.stop()

@@ -1,0 +1,790 @@
+"""Sharded parameter-server fleet: consistent-hash ring, per-tensor
+delta pulls (wire v2), int8 pulls with server-side error feedback,
+live shard add/drain, chaos shard kill + monitor recovery, the
+mixed-wire gang (dill + binary v1 + sharded delta) against one fleet,
+the transport's reconnect-time header re-read, and the collector's
+parallel scrape fan-in.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparktorch_tpu import serialize_torch_obj
+from sparktorch_tpu.ft import ChaosConfig, inject
+from sparktorch_tpu.models import ClassificationNet, Net
+from sparktorch_tpu.net import wire
+from sparktorch_tpu.net.sharded import (
+    HashRing,
+    HttpFleetView,
+    ShardedTransport,
+    StaticFleetView,
+)
+from sparktorch_tpu.net.transport import BinaryTransport, TransportError
+from sparktorch_tpu.obs import Telemetry
+from sparktorch_tpu.serve.fleet import ParamServerFleet, ParamShardServer
+from sparktorch_tpu.train.hogwild import train_async
+from sparktorch_tpu.utils.locks import TreeVersionedSlot
+from sparktorch_tpu.utils.serde import deserialize_model
+
+
+@pytest.fixture
+def payload():
+    return serialize_torch_obj(
+        Net(), criterion="mse", optimizer="adam",
+        optimizer_params={"lr": 5e-3}, input_shape=(10,),
+    )
+
+
+def _grads_like(params):
+    import jax
+
+    return jax.tree.map(lambda a: np.ones_like(np.asarray(a)), params)
+
+
+def _tree_allclose(a, b, atol=1e-6):
+    fa = dict(wire.flatten_tree(a))
+    fb = dict(wire.flatten_tree(b))
+    assert set(fa) == set(fb), (set(fa), set(fb))
+    for path in fa:
+        assert np.allclose(np.asarray(fa[path]), np.asarray(fb[path]),
+                           atol=atol), path
+
+
+# ---------------------------------------------------------------------------
+# Ring + slot + wire primitives
+# ---------------------------------------------------------------------------
+
+
+def test_hash_ring_deterministic_and_minimally_disruptive():
+    paths = [(f"layer{i}", leaf) for i in range(40)
+             for leaf in ("kernel", "bias")]
+    ring = HashRing(range(4))
+    owners = {p: ring.owner(p) for p in paths}
+    # Deterministic across instances (md5, not the salted builtin).
+    again = HashRing(range(4))
+    assert {p: again.owner(p) for p in paths} == owners
+    # Adding a shard moves only the keys on the new arcs (~1/5 here,
+    # never a full remap), and every move lands ON the new shard.
+    ring.add(4)
+    moved = {p for p in paths if ring.owner(p) != owners[p]}
+    assert 0 < len(moved) < len(paths) // 2
+    assert all(ring.owner(p) == "4" for p in moved)
+    # Removing a shard remaps ONLY its own keys.
+    drop = HashRing(range(4))
+    drop.remove(2)
+    for p in paths:
+        if owners[p] != "2":
+            assert drop.owner(p) == owners[p]
+    # Every shard id present in an assignment, even when empty.
+    assignment = HashRing(range(64)).assignment(paths[:4])
+    assert len(assignment) == 64
+    assert sum(len(v) for v in assignment.values()) == 4
+
+
+def test_tree_versioned_slot_per_leaf_versions():
+    slot = TreeVersionedSlot({("a",): np.zeros(2), ("b", "c"): np.ones(3)})
+    assert slot.version == 0
+    version, entries = slot.read_delta(-1)
+    assert version == 0 and len(entries) == 2
+    assert slot.read_delta(0) is None  # up to date
+    slot.swap_leaves({("a",): np.full(2, 5.0)})
+    version, entries = slot.read_delta(0)
+    assert version == 1
+    # Only the touched leaf advanced.
+    assert [(p, v) for p, _, v in entries] == [(("a",), 1)]
+    # Whole-tree swap restamps every leaf (legacy contract).
+    slot.swap({"a": np.zeros(2), "b": {"c": np.zeros(3)}})
+    version, entries = slot.read_delta(1)
+    assert version == 2 and len(entries) == 2
+    # Removal bumps the global version; the path stops appearing.
+    removed = slot.remove_leaves([("b", "c")])
+    assert set(removed) == {("b", "c")} and slot.version == 3
+    assert all(p != ("b", "c") for p, _, _ in slot.read_delta(-1)[1])
+    # Epochs are per-slot nonces (restart detection).
+    assert TreeVersionedSlot().epoch != TreeVersionedSlot().epoch
+
+
+def test_wire_v2_delta_frames_roundtrip_and_reject():
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": {"c": np.ones(4, np.int32)}}
+    leaf_versions = {("w",): 3, ("b", "c"): 7}
+    body = wire.frame_bytes(wire.encode(tree, version=9,
+                                        leaf_versions=leaf_versions))
+    assert body[4] == wire.WIRE_VERSION_DELTA
+    version, flat, vers = wire.decode_delta(body)
+    assert version == 9 and vers == leaf_versions
+    assert np.array_equal(flat[("w",)], tree["w"])
+    # decode() tolerates v2 (drops the tags)…
+    _, out = wire.decode(body)
+    assert np.array_equal(out["b"]["c"], tree["b"]["c"])
+    # …but a v1 frame is NOT a delta…
+    v1 = wire.frame_bytes(wire.encode(tree, version=9))
+    assert v1[4] == wire.WIRE_VERSION
+    with pytest.raises(wire.WireError):
+        wire.decode_delta(v1)
+    # …and truncated v2 frames are rejected at every boundary.
+    for cut in (wire.HEADER_SIZE - 1, wire.HEADER_SIZE + 3, len(body) - 1):
+        with pytest.raises(wire.WireError):
+            wire.decode(body[:cut])
+    # Quantized delta leaves dequantize on decode.
+    qleaves, _ = wire.quantize_tree({"w": tree["w"]}, "int8", {})
+    qbody = wire.frame_bytes(wire.encode(qleaves, version=11,
+                                         leaf_versions={("w",): 11}))
+    _, qflat, qvers = wire.decode_delta(qbody)
+    assert qflat[("w",)].dtype == np.float32 and qvers[("w",)] == 11
+
+
+# ---------------------------------------------------------------------------
+# Shard server: delta rendering + server-side int8 error feedback
+# ---------------------------------------------------------------------------
+
+
+def _mini_shard(telemetry=None):
+    import optax
+
+    leaves = {("w",): np.linspace(-1, 1, 256).astype(np.float32),
+              ("n", "steps"): np.arange(3, dtype=np.int32)}
+    return ParamShardServer("0", leaves,
+                            make_tx=lambda: optax.sgd(0.1),
+                            telemetry=telemetry)
+
+
+def test_shard_server_delta_pull_ships_only_advanced_leaves():
+    shard = _mini_shard()
+    try:
+        version, body = shard.render_delta(-1)
+        assert version == 0 and body is not None
+        _, flat, vers = wire.decode_delta(body)
+        assert set(flat) == {("w",), ("n", "steps")}
+        # Up to date -> no body (the route's 304).
+        version, body = shard.render_delta(0)
+        assert body is None
+        # A partial push touches one leaf; the delta ships ONLY it.
+        shard.push_gradients({("w",): np.ones(256, np.float32)})
+        shard.drain()
+        version, body = shard.render_delta(0)
+        _, flat, vers = wire.decode_delta(body)
+        assert set(flat) == {("w",)} and vers[("w",)] == 1
+        # A from-scratch client still gets everything.
+        _, full = shard.render_delta(-1)
+        _, flat_full, _ = wire.decode_delta(full)
+        assert set(flat_full) == {("w",), ("n", "steps")}
+        assert len(full) > len(body)
+    finally:
+        shard.stop()
+
+
+def test_shard_server_int8_pull_error_feedback_is_shared_and_exact():
+    shard = _mini_shard()
+    try:
+        w0 = np.asarray(dict(wire.flatten_tree(shard.slot.read()[1]))[("w",)])
+        _, body_a = shard.render_delta(-1, quant="int8")
+        _, body_b = shard.render_delta(-1, quant="int8")
+        # One quantization per (leaf, version): every client pulling
+        # the same version gets identical bytes (EF consumed once).
+        assert body_a == body_b
+        _, flat, _ = wire.decode_delta(body_a)
+        served = np.asarray(flat[("w",)])
+        # The residual complements the served value exactly.
+        residual = shard._pull_residuals[("w",)]
+        assert np.allclose(served + residual, w0, atol=1e-6)
+        # Error feedback across versions: the next version's served
+        # value folds the previous residual in.
+        shard.push_gradients({("w",): np.full(256, 0.01, np.float32)})
+        shard.drain()
+        w1 = np.asarray(dict(wire.flatten_tree(shard.slot.read()[1]))[("w",)])
+        _, body2 = shard.render_delta(0, quant="int8")
+        _, flat2, _ = wire.decode_delta(body2)
+        assert np.allclose(np.asarray(flat2[("w",)])
+                           + shard._pull_residuals[("w",)],
+                           w1 + residual, atol=1e-6)
+        # int8 bodies are materially smaller than f32 ones.
+        _, f32_body = shard.render_delta(-1)
+        assert len(body_a) < len(f32_body)
+    finally:
+        shard.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet: scatter/gather, per-shard accounting, mixed-wire gang
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_transport_scatter_gather_and_delta(payload):
+    tele = Telemetry(run_id="fleet_sg")
+    fleet = ParamServerFleet(payload, n_shards=3, telemetry=tele).start()
+    try:
+        t = ShardedTransport(fleet, telemetry=tele, run_id=tele.run_id)
+        snap = t.pull(-1)
+        assert snap is not None
+        version, params = snap
+        _tree_allclose(params, fleet.assemble())
+        assert t.pull(version) is None  # every shard said 304
+        t.push(_grads_like(params))
+        fleet.drain()
+        owners = [s for s in fleet._shards.values() if s.slot.paths]
+        assert fleet.applied_updates == len(owners)
+        snap2 = t.pull(version)
+        assert snap2 is not None and snap2[0] > version
+        _tree_allclose(snap2[1], fleet.assemble())
+        full_bytes = t.stats["pull_bytes"]
+
+        # Sparse update: only one leaf advances -> the next delta
+        # ships strictly fewer bytes than the initial full pull.
+        flat = dict(wire.flatten_tree(
+            deserialize_model(payload).init_params(__import__("jax").random.key(0))["params"]))
+        sparse_path = sorted(flat)[0]
+        fleet.scatter_push({sparse_path: np.ones_like(flat[sparse_path])})
+        fleet.drain()
+        before = t.stats["pull_bytes"]
+        snap3 = t.pull(snap2[0])
+        assert snap3 is not None
+        delta_bytes = t.stats["pull_bytes"] - before
+        assert 0 < delta_bytes < full_bytes / 2
+        _tree_allclose(snap3[1], fleet.assemble())
+
+        # Per-shard byte accounting on the bus: every owning shard's
+        # /delta.bin series carries real bytes.
+        counters = tele.snapshot()["counters"]
+        for shard in owners:
+            key = ("param_server.wire_bytes_total"
+                   f"{{dir=tx,route=/delta.bin,shard={shard.shard_id}}}")
+            assert counters.get(key, 0) > 0, (key, sorted(counters))
+        t.close()
+    finally:
+        fleet.stop()
+
+
+def test_mixed_wire_gang_trains_against_one_fleet(payload):
+    """The satellite's mixed-wire gang: a dill worker and a binary-v1
+    worker through the fleet GATEWAY, a sharded delta worker against
+    the shards — one fleet, one coherent model, per-shard AND gateway
+    byte accounting asserted."""
+    import jax
+
+    from sparktorch_tpu.train.hogwild import (
+        HttpTransport,
+        _worker_loop,
+        make_grad_step,
+    )
+    from sparktorch_tpu.utils.data import DataBatch
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.0, 1.0, (120, 10)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+
+    tele = Telemetry(run_id="fleet_mixed")
+    fleet = ParamServerFleet(payload, n_shards=2, window_len=3,
+                             telemetry=tele).start()
+    try:
+        spec = deserialize_model(payload)
+        module = spec.make_module()
+        grad_step = make_grad_step(module.apply, spec.loss_fn(),
+                                   mini_batch=20)
+        transports = [
+            HttpTransport(fleet.gateway_url),        # dill (legacy)
+            BinaryTransport(fleet.gateway_url),      # binary v1 (legacy)
+            ShardedTransport(fleet, telemetry=tele),  # sharded delta
+        ]
+        device = jax.devices()[0]
+        records, errors = [], []
+        iters = 6
+        threads = []
+        for i, transport in enumerate(transports):
+            shard_rows = DataBatch(
+                np.asarray(x[i::3]), np.asarray(y[i::3]),
+                np.ones(x[i::3].shape[0], np.float32),
+            )
+            thread = threading.Thread(
+                target=_worker_loop,
+                args=(i, device, transport, grad_step,
+                      fleet.model_state(), shard_rows, None, iters, 0,
+                      False, 0, records, errors),
+                daemon=True,
+            )
+            threads.append(thread)
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=120)
+        assert not errors, errors
+        fleet.drain()
+        # Exact record counts: every worker flushed its assignment.
+        assert len(records) == 3 * iters
+        assert {r["worker"] for r in records} == {0, 1, 2}
+        # Every wire moved real bytes, and the fleet applied pushes
+        # from all three (gateway pushes scatter to BOTH shards; the
+        # sharded worker pushes per shard).
+        for transport in transports:
+            assert transport.stats["push_bytes"] > 0
+            assert transport.stats["pushes"] == iters
+        counters = tele.snapshot()["counters"]
+        # Gateway (unsharded) series for the legacy wires…
+        assert counters.get(
+            "param_server.wire_bytes_total{dir=rx,route=/update.bin}", 0) > 0
+        assert counters.get(
+            "param_server.wire_bytes_total{dir=tx,route=/parameters}", 0) > 0
+        # …and per-shard delta series for the sharded worker.
+        per_shard = [k for k in counters
+                     if k.startswith("param_server.wire_bytes_total")
+                     and "route=/delta.bin" in k and "shard=" in k]
+        assert per_shard, sorted(counters)
+        # All three observed advancing versions against ONE model.
+        assert max(r["version"] for r in records) > 0
+        for transport in transports:
+            close = getattr(transport, "close", None)
+            if close:
+                close()
+    finally:
+        fleet.stop()
+
+
+def test_shard_add_and_drain_mid_run_exact_records(payload):
+    """Live resharding under traffic: a shard joins mid-run, another
+    drains, and the worker finishes its exact assignment — no lost
+    records, no lost leaves, and the client followed the ring."""
+    import jax
+
+    from sparktorch_tpu.train.hogwild import _worker_loop, make_grad_step
+    from sparktorch_tpu.utils.data import DataBatch
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(0.0, 1.0, (80, 10)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+
+    tele = Telemetry(run_id="fleet_reshard")
+    fleet = ParamServerFleet(payload, n_shards=2, telemetry=tele).start()
+    try:
+        n_leaves = len(dict(wire.flatten_tree(fleet.assemble())))
+        spec = deserialize_model(payload)
+        module = spec.make_module()
+        grad_step = make_grad_step(module.apply, spec.loss_fn(),
+                                   mini_batch=16)
+        transport = ShardedTransport(fleet, telemetry=tele)
+        records, errors = [], []
+        iters = 12
+        batch = DataBatch(x, y, np.ones(x.shape[0], np.float32))
+        worker = threading.Thread(
+            target=_worker_loop,
+            args=(0, jax.devices()[0], transport, grad_step,
+                  fleet.model_state(), batch, None, iters, 0, False, 0,
+                  records, errors),
+            daemon=True,
+        )
+        worker.start()
+        time.sleep(0.3)
+        new_sid = fleet.add_shard()      # grow mid-run
+        time.sleep(0.3)
+        moved = fleet.drain_shard("0")   # shrink mid-run
+        worker.join(timeout=120)
+        assert not errors, errors
+        assert len(records) == iters     # exact record count
+        fleet.drain()
+        # No leaf lost through two migrations.
+        assert len(dict(wire.flatten_tree(fleet.assemble()))) == n_leaves
+        assert moved >= 0 and new_sid in fleet.urls()
+        assert "0" not in fleet.urls()
+        assert fleet.ring_version == 3   # add + drain
+        # The client converged onto the new ring and can still pull.
+        snap = transport.pull(-1)
+        assert snap is not None
+        _tree_allclose(snap[1], fleet.assemble())
+        transport.close()
+    finally:
+        fleet.stop()
+
+
+def test_chaos_shard_kill_recovers_within_grace(payload):
+    """Seeded shard kill (ft.chaos `fleet.shard` site): the client
+    degrades to the remaining ring (counted, not fatal), the fleet
+    monitor restarts the frontend inside the grace window, and the
+    run completes with exact record counts."""
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(0, 1, (60, 10)),
+                        rng.normal(2, 1, (60, 10))]).astype(np.float32)
+    y = np.concatenate([np.zeros(60), np.ones(60)]).astype(np.float32)
+    tele = Telemetry(run_id="fleet_kill")
+    t0 = time.perf_counter()
+    with inject(ChaosConfig(kill_shard_at={1: 4}), telemetry=tele) as inj:
+        result = train_async(payload, x, labels=y, iters=10, partitions=2,
+                             seed=0, transport="http", shards=3,
+                             telemetry=tele)
+    wall = time.perf_counter() - t0
+    assert [e for e in inj.events if e["site"] == "fleet.shard"], inj.events
+    assert len(result.metrics) == 20     # exact records through the kill
+    assert result.summary["fleet"]["shard_restarts"] >= 1
+    counters = tele.snapshot()["counters"]
+    assert counters.get("fleet.shard_restarts_total{shard=1}", 0) >= 1
+    # Recovered well inside the transport's default 30s grace window.
+    assert wall < 30.0, wall
+    # Recovery latency was observed on the bus.
+    assert tele.histogram("fleet.shard_recovery_latency_s")["count"] >= 1
+
+
+def test_train_async_sharded_sorted_input_regression(payload):
+    """The sorted-input convergence bar, now over the fleet: sharding
+    the server must not change what training converges to."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(0.0, 1.0, (100, 10)),
+                        rng.normal(2.0, 1.0, (100, 10))]).astype(np.float32)
+    y = np.concatenate([np.zeros(100), np.ones(100)]).astype(np.float32)
+    clf = serialize_torch_obj(
+        ClassificationNet(n_classes=2), criterion="cross_entropy",
+        optimizer="adam", optimizer_params={"lr": 5e-3}, input_shape=(10,),
+    )
+    result = train_async(clf, x, labels=y, iters=25, partitions=2, seed=0,
+                         transport="http", shards=3, pull_quant="int8")
+    spec = deserialize_model(clf)
+    module = spec.make_module()
+    preds = np.argmax(np.asarray(
+        module.apply({"params": result.params}, jnp.asarray(x))), axis=1)
+    acc = float((preds == y).mean())
+    assert acc > 0.9, acc
+    assert result.summary["fleet"]["shards"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Transport reconnect semantics (the satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_pull_retry_rereads_live_have_version(payload):
+    """A pull retried after a reconnect must re-read its live version
+    source at send time: replaying the header captured before the
+    first attempt would ship a stale X-Have-Version and let a delta
+    pull miss (or re-ship) an update."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    seen = []
+
+    class Recorder(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            seen.append(self.headers.get("X-Have-Version"))
+            if len(seen) == 1:
+                # First attempt dies mid-conversation, like a shard
+                # frontend going down. shutdown(SHUT_RDWR) puts the
+                # FIN on the wire NOW (close() alone leaves the fd
+                # alive behind rfile/wfile refs and the client would
+                # sit out its whole pull timeout).
+                import socket as _s
+
+                self.connection.shutdown(_s.SHUT_RDWR)
+                return
+            body = wire.frame_bytes(wire.encode(
+                {"w": np.ones(2, np.float32)}, version=9,
+                leaf_versions={("w",): 9}))
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Recorder)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        t = BinaryTransport(f"http://127.0.0.1:{httpd.server_address[1]}",
+                            retries=4, backoff_s=0.01)
+        live = {"have": 3}
+        res = t.pull_delta(lambda: live.pop("have", 7))
+        # First attempt read the live value (3); the RETRY re-read it
+        # and saw the advanced value (7) — not a replay of 3.
+        assert seen == ["3", "7"], seen
+        assert res["fresh"] and res["leaf_versions"][("w",)] == 9
+        t.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_pull_from_scratch_returns_state_even_when_all_shards_304(payload):
+    """A supervisor-restarted worker reuses its transport and pulls
+    with have=-1: even if no shard advanced since the last sweep, the
+    from-scratch caller must get the (cached, current) tree — not
+    None, which would send the restarted loop into grad_step with
+    params=None."""
+    fleet = ParamServerFleet(payload, n_shards=2).start()
+    try:
+        t = ShardedTransport(fleet)
+        snap = t.pull(-1)
+        assert snap is not None
+        version = snap[0]
+        assert t.pull(version) is None      # up to date: a real 304
+        again = t.pull(-1)                  # the restart contract
+        assert again is not None
+        _tree_allclose(again[1], fleet.assemble())
+        t.close()
+    finally:
+        fleet.stop()
+
+
+def test_shard_epoch_resync_after_server_replacement(payload):
+    """A shard whose slot was REBUILT (drain + re-add, restart from
+    scratch) restarts its version numbering; the client must detect
+    the epoch change and resync from -1 instead of trusting version
+    arithmetic."""
+    import optax
+
+    leaves = {("w",): np.ones(4, np.float32)}
+    shard_a = ParamShardServer("0", leaves, make_tx=lambda: optax.sgd(0.1))
+    from sparktorch_tpu.serve.param_server import ParamServerHttp
+
+    http = ParamServerHttp(shard_a, port=0, shard="0").start()
+    port = http.port
+    tele = Telemetry(run_id="epoch_resync")
+    try:
+        t = ShardedTransport(
+            StaticFleetView({"0": f"http://127.0.0.1:{port}"}),
+            telemetry=tele)
+        snap = t.pull(-1)
+        assert snap is not None
+        # Advance the shard a few versions so the client's have > 0.
+        for _ in range(3):
+            shard_a.push_gradients({("w",): np.ones(4, np.float32)})
+        shard_a.drain()
+        assert t.pull(0) is not None
+        have_before = t._clients["0"].have
+        assert have_before == 3
+        # Replace the server behind the same port: fresh slot, fresh
+        # epoch, version counter back at 0 — and a DIFFERENT value.
+        http.stop()
+        shard_a.stop()
+        shard_b = ParamShardServer(
+            "0", {("w",): np.full(4, 42.0, np.float32)},
+            make_tx=lambda: optax.sgd(0.1))
+        http = ParamServerHttp(shard_b, port=port, shard="0").start()
+        snap = t.pull(have_before)
+        # Without the epoch resync this would be None forever
+        # (0 <= 3) and the client would train on stale weights.
+        assert snap is not None
+        assert np.allclose(np.asarray(snap[1]["w"]), 42.0)
+        assert tele.counter_value("sharded_epoch_resyncs_total",
+                                  labels={"shard": "0"}) >= 1
+        t.close()
+        shard_b.stop()
+    finally:
+        http.stop()
+
+
+def test_sharded_transport_grace_window_degrades_then_fails(payload):
+    """A dead shard degrades (counted) inside the grace window and
+    fails the worker only past it."""
+    import optax
+
+    shard = ParamShardServer("0", {("w",): np.ones(2, np.float32)},
+                             make_tx=lambda: optax.sgd(0.1))
+    from sparktorch_tpu.serve.param_server import ParamServerHttp
+
+    http = ParamServerHttp(shard, port=0, shard="0").start()
+    tele = Telemetry(run_id="grace")
+    try:
+        t = ShardedTransport(
+            StaticFleetView({"0": f"http://127.0.0.1:{http.port}"}),
+            grace_s=0.5, telemetry=tele,
+            retries=1, backoff_s=0.01, deadline_s=0.2)
+        assert t.pull(-1) is not None
+        http.stop()  # shard dies; no monitor here to bring it back
+        # Inside the grace window: degraded pull (None — cached
+        # leaves freeze), degraded push (dropped + counted), no raise.
+        assert t.pull(10**9) is None
+        t.push({"w": np.ones(2, np.float32)})
+        assert t.stats["shard_failures"] >= 2
+        assert t.stats["pushes_skipped"] >= 1
+        assert tele.counter_value("sharded_shard_failures_total",
+                                  labels={"shard": "0", "op": "pull"}) >= 1
+        # Past the grace window: fatal.
+        time.sleep(0.6)
+        with pytest.raises(TransportError, match="grace"):
+            t.pull(10**9)
+        t.close()
+    finally:
+        http.stop()
+        shard.stop()
+
+
+# ---------------------------------------------------------------------------
+# Discovery + collector fan-in
+# ---------------------------------------------------------------------------
+
+
+def test_pull_never_synced_shard_fails_loud_not_partial(payload):
+    """A shard unreachable before its FIRST sync has no cached leaves
+    to degrade to: the pull must raise (supervisor retries after the
+    monitor restart), never hand the worker a partial tree that
+    crashes inside flax."""
+    import optax
+
+    shard = ParamShardServer("0", {("w",): np.ones(2, np.float32)},
+                             make_tx=lambda: optax.sgd(0.1))
+    from sparktorch_tpu.serve.param_server import ParamServerHttp
+
+    http = ParamServerHttp(shard, port=0, shard="0").start()
+    try:
+        # Shard "1" points at a dead port: first sync can't complete.
+        t = ShardedTransport(
+            StaticFleetView({"0": f"http://127.0.0.1:{http.port}",
+                             "1": "http://127.0.0.1:9"}),
+            grace_s=5.0, retries=1, backoff_s=0.01, deadline_s=0.3)
+        with pytest.raises(TransportError, match="first sync"):
+            t.pull(-1)
+        t.close()
+    finally:
+        http.stop()
+        shard.stop()
+
+
+def test_resync_retry_failure_degrades_not_fatal(payload):
+    """An epoch resync resets the client's have-version to -1 while
+    its leaf cache stays complete; a failure at that instant (the
+    shard is mid-restart — flakiness is at its most likely) must take
+    the grace-window degrade path, not be misclassified as
+    'never synced' and kill the worker."""
+    import optax
+
+    shard = ParamShardServer("0", {("w",): np.ones(2, np.float32)},
+                             make_tx=lambda: optax.sgd(0.1))
+    from sparktorch_tpu.serve.param_server import ParamServerHttp
+
+    http = ParamServerHttp(shard, port=0, shard="0").start()
+    try:
+        t = ShardedTransport(
+            StaticFleetView({"0": f"http://127.0.0.1:{http.port}"}),
+            grace_s=5.0, retries=1, backoff_s=0.01, deadline_s=0.3)
+        assert t.pull(-1) is not None      # first sync lands
+        client = t._clients["0"]
+        client.have = -1                   # what an epoch resync does
+        http.stop()                        # …and the retry then fails
+        assert t.pull(10**9) is None       # degrade: cache is complete
+        assert t.stats["shard_failures"] >= 1
+        t.close()
+    finally:
+        http.stop()
+        shard.stop()
+
+
+def test_fleet_json_discovery_and_http_view(payload):
+    fleet = ParamServerFleet(payload, n_shards=2).start()
+    try:
+        # Served by every shard AND the gateway.
+        for url in list(fleet.urls().values()) + [fleet.gateway_url]:
+            doc = HttpFleetView(url).describe()
+            assert doc["ring_version"] == fleet.ring_version
+            assert set(doc["shards"]) == {"0", "1"}
+        # A transport built from the HTTP view works like in-process.
+        t = ShardedTransport(HttpFleetView(fleet.gateway_url))
+        snap = t.pull(-1)
+        assert snap is not None
+        _tree_allclose(snap[1], fleet.assemble())
+        t.close()
+        # Fleet-aware collector targets: default is ONE deduplicated
+        # target (all in-process shards share a single bus — scraping
+        # every frontend would multiply each series by the target
+        # count); per_shard=True is the process-per-shard shape.
+        assert set(fleet.collector_targets()) == {"fleet"}
+        assert set(fleet.collector_targets(per_shard=True)) == {
+            "shard0", "shard1", "gateway"}
+    finally:
+        fleet.stop()
+
+
+def test_collector_parallel_poll_under_deadline_budget():
+    """The fan-in satellite: N targets scrape in PARALLEL under a
+    sweep deadline — one hung target costs ~one timeout, not N, and
+    is counted as a deadline miss while the others merge."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from sparktorch_tpu.obs.collector import FleetCollector
+
+    def _make_exporter(delay_s):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                time.sleep(delay_s)
+                body = json.dumps({
+                    "run_id": f"rank-{delay_s}", "counters": {"x": 1.0},
+                    "gauges": {}, "histograms": {}, "spans": {},
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+
+    fast = [_make_exporter(0.0) for _ in range(3)]
+    slow = _make_exporter(30.0)  # never answers inside any budget
+    servers = fast + [slow]
+    try:
+        targets = {i: f"http://127.0.0.1:{s.server_address[1]}"
+                   for i, s in enumerate(servers)}
+        collector = FleetCollector(targets, scrape_timeout_s=0.5,
+                                   poll_deadline_s=1.5)
+        t0 = time.perf_counter()
+        merged = collector.poll()
+        wall = time.perf_counter() - t0
+        # Parallel: ~one budget, not 4 serial timeouts.
+        assert wall < 3.0, wall
+        # Fast ranks merged (rank-labeled series present)…
+        counters = merged["counters"]
+        for rank in ("0", "1", "2"):
+            assert any(f"rank={rank}" in k and k.startswith("x")
+                       for k in counters), sorted(counters)
+        # …the hung rank is visible as missing/errored, not torn.
+        assert merged["ranks"]["3"]["ok"] is False
+        own = collector.telemetry.snapshot()["counters"]
+        missed = sum(v for k, v in own.items()
+                     if k.startswith("collector.scrape_deadline_misses")
+                     or k.startswith("collector.scrape_errors"))
+        assert missed >= 1, own
+        collector.stop()
+    finally:
+        for s in servers:
+            s.shutdown()
+            s.server_close()
+
+
+def test_collector_serial_mode_unchanged():
+    """poll_parallelism=1 restores the serial sweep (the pre-fleet
+    behavior some tests and small rigs rely on)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from sparktorch_tpu.obs.collector import FleetCollector
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"run_id": "r", "counters": {"y": 2.0},
+                               "gauges": {}, "histograms": {},
+                               "spans": {}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        collector = FleetCollector(
+            {0: f"http://127.0.0.1:{httpd.server_address[1]}"},
+            poll_parallelism=1)
+        merged = collector.poll()
+        assert any(k.startswith("y{") for k in merged["counters"])
+        collector.stop()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
