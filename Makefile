@@ -6,7 +6,7 @@ PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
-	bench-ps-fleet cluster-up clean lint-obs
+	bench-ps-fleet bench-tune cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -35,7 +35,8 @@ lint-obs:
 	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
 		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:' \
 		| grep -v '^sparktorch_tpu/net/bench_wire\.py:' \
-		| grep -v '^sparktorch_tpu/obs/timeline\.py:'); \
+		| grep -v '^sparktorch_tpu/obs/timeline\.py:' \
+		| grep -v '^sparktorch_tpu/parallel/tune\.py:'); \
 	if [ -n "$$hits" ]; then \
 		echo "lint-obs: raw print() in library code (use obs.get_logger):"; \
 		echo "$$hits"; exit 1; \
@@ -123,12 +124,31 @@ bench-chaos-soak:
 # it offline (obs.xprof), and FAIL unless >=1 collective is found, the
 # step-slice wall reconciles with the bus span wall, and a real
 # /metrics scrape equals the JSONL telemetry dump for the xprof
-# metrics. Defaults to the 8-virtual-device CPU backend so it runs
-# anywhere (override JAX_PLATFORMS/XLA_FLAGS for a real accelerator).
+# metrics. The gang_obs config runs second so bench-trace is ALSO
+# gated on xprof.gang_* drift (cross-rank step skew growth, gang comm
+# fraction rise vs the newest prior gang record; no_prior_record skip
+# until a multi-host round has recorded one). Defaults to the
+# 8-virtual-device CPU backend so it runs anywhere (override
+# JAX_PLATFORMS/XLA_FLAGS for a real accelerator);
+# SPARKTORCH_TPU_TRACE_MESH=auto lets the mesh auto-tuner pick the
+# layout under the capture instead of the fixed tp2.
 bench-trace:
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
 	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
 	$(PYTHON) -m sparktorch_tpu.bench --config sharded_trace
+	$(PYTHON) -m sparktorch_tpu.bench --config gang_obs
+
+# Mesh auto-tuner gate: the trace-guided tuner (enumerate -> analytic
+# comm-volume prune -> profiled measurement with early stop) must pick
+# a mesh within tolerance (default 10% step wall) of the exhaustively
+# measured winner on this rig, with >=1 candidate pruned without
+# execution, the measured winner never pruned, the profiled-step
+# budget respected, and the full ranking emitted in tune_result.json —
+# FAILS otherwise. Defaults to the 8-virtual-device CPU backend.
+bench-tune:
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
+	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+	$(PYTHON) -m sparktorch_tpu.bench --config mesh_tune
 
 # Gang-observability gate: spin local rank exporters, run the fleet
 # collector, and FAIL unless the merged scrape reconciles with the

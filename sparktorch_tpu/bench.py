@@ -1014,13 +1014,19 @@ def bench_hogwild_ps_fleet() -> dict:
     }
 
 
-def _prior_comm_budget(config: str,
-                       root: Optional[str] = None) -> Optional[dict]:
+def _prior_record(config: str, field: str,
+                  root: Optional[str] = None,
+                  mesh: Optional[str] = None) -> Optional[dict]:
     """The most recent PRIOR round's record for ``config`` that
-    carries a comm budget — scanned from the retained round artifacts
+    carries ``field`` — scanned from the retained round artifacts
     (repo-root ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` and the
-    ``benchmarks/*.jsonl`` logs). None when no prior record exists
-    (first armed round: the drift gate skips cleanly)."""
+    ``benchmarks/*.jsonl`` logs). ``mesh`` restricts the scan to
+    records captured under the SAME layout (or predating the mesh
+    field): the SPARKTORCH_TPU_TRACE_MESH=auto knob means adjacent
+    rounds can capture different layouts with legitimately different
+    comm budgets, and the newest same-mesh prior — not the newest
+    prior outright — is the valid baseline. None when no (matching)
+    prior exists (first armed round: the drift gate skips cleanly)."""
     import glob
     import os
     import re
@@ -1040,7 +1046,8 @@ def _prior_comm_budget(config: str,
     # every BENCH_r*.json and compare the gate against a stale round.
     def _consider(rec, path):
         if isinstance(rec, dict) and rec.get("config") == config \
-                and rec.get("comm_fraction") is not None:
+                and rec.get(field) is not None \
+                and (mesh is None or rec.get("mesh") in (None, mesh)):
             candidates.append(((str(rec.get("ts") or ""),
                                 _round_of(path)), rec))
 
@@ -1068,8 +1075,75 @@ def _prior_comm_budget(config: str,
     return max(candidates, key=lambda c: c[0])[1]
 
 
+def _prior_comm_budget(config: str,
+                       root: Optional[str] = None,
+                       mesh: Optional[str] = None) -> Optional[dict]:
+    """Most recent prior record of ``config`` with a comm budget —
+    restricted to the same mesh layout when one is named."""
+    return _prior_record(config, "comm_fraction", root, mesh=mesh)
+
+
+def _prior_gang_budget(config: str,
+                       root: Optional[str] = None) -> Optional[dict]:
+    """Most recent prior record of ``config`` carrying a MERGED gang
+    budget (``gang_comm_fraction`` — what ``gang_obs`` and multi-host
+    rounds report). None until a multi-host round has recorded one."""
+    return _prior_record(config, "gang_comm_fraction", root)
+
+
+def _check_gang_drift(config: str, step_skew_s: float,
+                      gang_comm_fraction: float) -> dict:
+    """The GANG-level drift gate (PR 5 follow-up, armed): compare this
+    run's merged cross-rank step skew and gang comm fraction against
+    the newest prior round's gang record and FAIL when a rank started
+    straggling (skew grew beyond tolerance) or gang comm grew to
+    dominate the budget. Skips cleanly (``no_prior_record``) until a
+    multi-host round has recorded a gang budget. Tolerances:
+    ``SPARKTORCH_TPU_COMM_DRIFT_TOL`` (absolute, on the fraction —
+    shared with the per-rank gate) and ``SPARKTORCH_TPU_GANG_SKEW_TOL``
+    (relative growth on the skew, default 0.5 = +50%, with a 50ms
+    absolute floor so microsecond-scale synthetic skews don't trip on
+    rounding)."""
+    import os
+
+    tol = float(os.environ.get("SPARKTORCH_TPU_COMM_DRIFT_TOL", "0.25"))
+    skew_tol = float(os.environ.get("SPARKTORCH_TPU_GANG_SKEW_TOL", "0.5"))
+    prior = _prior_gang_budget(config)
+    if prior is None:
+        return {"status": "no_prior_record", "tolerance": tol,
+                "skew_tolerance": skew_tol}
+    prior_cf = float(prior["gang_comm_fraction"])
+    prior_skew = float(prior.get("gang_step_skew_s", 0.0))
+    skew_limit = prior_skew * (1.0 + skew_tol) + 0.05
+    drift = {
+        "status": "checked",
+        "tolerance": tol,
+        "skew_tolerance": skew_tol,
+        "prior_ts": prior.get("ts"),
+        "prior_gang_comm_fraction": round(prior_cf, 4),
+        "prior_gang_step_skew_s": round(prior_skew, 6),
+        "gang_comm_fraction_delta": round(gang_comm_fraction - prior_cf, 4),
+        "gang_step_skew_delta_s": round(step_skew_s - prior_skew, 6),
+    }
+    if step_skew_s > skew_limit:
+        raise AssertionError(
+            f"{config}: gang step skew regressed "
+            f"{prior_skew:.4f}s -> {step_skew_s:.4f}s (past the "
+            f"{skew_limit:.4f}s limit) — a rank is straggling; "
+            f"drift: {drift}"
+        )
+    if gang_comm_fraction - prior_cf > tol:
+        raise AssertionError(
+            f"{config}: gang comm_fraction regressed "
+            f"{prior_cf:.3f} -> {gang_comm_fraction:.3f} "
+            f"(comm grew beyond the {tol} tolerance); drift: {drift}"
+        )
+    return drift
+
+
 def _check_comm_drift(config: str, comm_fraction: float,
-                      overlap_fraction: float) -> dict:
+                      overlap_fraction: float,
+                      mesh: Optional[str] = None) -> dict:
     """The comm-fraction drift gate (ROADMAP follow-up, armed): now
     that ``sharded_trace`` and ``moe_lm`` record ``comm_budget`` every
     round, compare this run's fractions against the previous round's
@@ -1079,13 +1153,22 @@ def _check_comm_drift(config: str, comm_fraction: float,
     dominate the step. Skips cleanly when no prior record exists.
     Tolerance is absolute on the fractions (default 0.25 — generous
     for CPU-rig jitter; tighten via SPARKTORCH_TPU_COMM_DRIFT_TOL on
-    stable hardware). Returns the drift record the bench attaches."""
+    stable hardware). ``mesh`` (when the config records one — the
+    SPARKTORCH_TPU_TRACE_MESH=auto knob means different rounds can
+    capture different LAYOUTS) guards the baseline: the prior scan is
+    restricted to the newest record captured under the SAME mesh
+    (records predating the mesh field compare as before), so an
+    auto-mode round can neither raise a fake regression against a
+    tp2 baseline nor mask a real one — and interleaved tp2/auto
+    rounds still each find their own valid baseline instead of
+    skipping forever. Returns the drift record the bench attaches."""
     import os
 
     tol = float(os.environ.get("SPARKTORCH_TPU_COMM_DRIFT_TOL", "0.25"))
-    prior = _prior_comm_budget(config)
+    prior = _prior_comm_budget(config, mesh=mesh)
     if prior is None:
-        return {"status": "no_prior_record", "tolerance": tol}
+        return {"status": "no_prior_record", "tolerance": tol,
+                "mesh": mesh}
     prior_cf = float(prior["comm_fraction"])
     prior_of = float(prior.get("overlap_fraction", 0.0))
     drift = {
@@ -1174,14 +1257,37 @@ def bench_sharded_trace() -> dict:
                 w=np.ones((bsz,), np.float32),
             )
         with tele.span("bench/init") as _sp_init:
-            # tp=2 when it divides the rig: tensor-parallel all-reduces
-            # INSIDE the step, beside the dp gradient reduction.
-            mesh = build_mesh(MeshConfig(tp=2) if n_dev % 2 == 0
-                              else MeshConfig(), devices)
+            import os
+
             module = SequenceClassifier(tiny_transformer())
             spec = ModelSpec(module=module, loss="cross_entropy",
                              optimizer="adam", optimizer_params={"lr": 1e-3})
             tx = spec.make_optimizer()
+            # Mesh knob: tp2 (default — tensor-parallel all-reduces
+            # INSIDE the step, beside the dp gradient reduction), or
+            # "auto" to let the trace-guided tuner pick the layout
+            # (SPARKTORCH_TPU_TRACE_MESH=auto make bench-trace).
+            mesh_knob = os.environ.get("SPARKTORCH_TPU_TRACE_MESH", "tp2")
+            if mesh_knob not in ("tp2", "auto"):
+                raise AssertionError(
+                    f"SPARKTORCH_TPU_TRACE_MESH={mesh_knob!r}: "
+                    f"use 'tp2' or 'auto'"
+                )
+            if mesh_knob == "auto":
+                from sparktorch_tpu.parallel.tune import autotune
+
+                tuned = autotune(spec, batch, devices, steps=3,
+                                 measure_top_k=3, telemetry=tele)
+                mesh = build_mesh(tuned.best_config(), devices)
+            else:
+                mesh = build_mesh(MeshConfig(tp=2) if n_dev % 2 == 0
+                                  else MeshConfig(), devices)
+            # Recorded from the mesh actually built — never the knob
+            # (the tp2 fallback on an odd rig is pure dp, and the
+            # retained record must say so).
+            from sparktorch_tpu.parallel.tune import mesh_label
+
+            mesh_ran = mesh_label(dict(mesh.shape))
             state, shardings = create_sharded_state(
                 spec, mesh, jax.random.key(0), sample_x=batch.x[:1], tx=tx,
             )
@@ -1283,7 +1389,7 @@ def bench_sharded_trace() -> dict:
         # ---- comm-fraction drift gate (vs the previous round) ------------
         comm_drift = _check_comm_drift(
             "sharded_trace", analysis.comm_fraction,
-            analysis.overlap_fraction,
+            analysis.overlap_fraction, mesh=mesh_ran,
         )
 
         return {
@@ -1299,6 +1405,7 @@ def bench_sharded_trace() -> dict:
             "n_collective_events": analysis.n_collective_events,
             "n_steps": len(analysis.steps),
             "n_chips": n_dev,
+            "mesh": mesh_ran,
             "reconcile": {"steps_wall_s": round(step_wall, 6),
                           "span_wall_s": round(span_wall, 6)},
             "top_ops": analysis.top_ops[:5],
@@ -1311,6 +1418,202 @@ def bench_sharded_trace() -> dict:
                 "measure": round(_sp_measure.duration_s, 3),
                 "comm_s": round(analysis.comm_s, 6),
             },
+        }
+    finally:
+        if jax.default_backend() == "cpu":
+            jax.config.update("jax_compilation_cache_dir", old_cache)
+
+
+def bench_mesh_tune() -> dict:
+    """Mesh auto-tuner gate (``make bench-tune``): run the trace-guided
+    tuner (:mod:`sparktorch_tpu.parallel.tune`) on a transformer
+    workload over the local rig, then referee it against an EXHAUSTIVE
+    measurement of the same candidate space, and FAIL unless
+
+    - the tuner's chosen mesh matches the exhaustively-measured winner,
+      or sits within tolerance (``SPARKTORCH_TPU_TUNE_TOL``, default
+      10%) of its step wall — compared on the exhaustive pass's OWN
+      numbers so run-to-run jitter can't fake a pass;
+    - the prune step eliminated >=1 candidate WITHOUT executing it,
+      and never eliminated the measured winner — judged at the same
+      tolerance (a pruned candidate materially faster than the chosen
+      config fails; one inside the noise between the top entries does
+      not, because there the "winner" label is itself jitter);
+    - the tuner stayed under its execution budget: profiled steps
+      executed (warmup captures included) <=
+      measure_top_k x steps x (repeats + warmup rounds), and the
+      search wall under ``SPARKTORCH_TPU_TUNE_BUDGET_S``
+      (default 600s);
+    - the full ranking + prune log round-trips through the
+      ``tune_result.json`` artifact.
+
+    The record reports both rankings, the prune decisions, and the
+    chosen budget."""
+    import os
+    import tempfile
+
+    import jax
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.obs import Telemetry
+    from sparktorch_tpu.parallel.tune import TuneResult, autotune
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    # Same CPU compile-cache disarm as sharded_trace: candidates
+    # execute collective-bearing GSPMD programs (see tests/conftest.py).
+    old_cache = jax.config.jax_compilation_cache_dir
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        t0 = time.perf_counter()
+        tele = Telemetry(run_id="bench_mesh_tune")
+        devices = jax.devices()
+        n_dev = len(devices)
+        rng = np.random.default_rng(0)
+        bsz = 8 * n_dev
+        seq = 32
+        batch = DataBatch(
+            x=np.asarray(rng.integers(0, 256, (bsz, seq)).astype(np.int32)),
+            y=np.asarray(rng.integers(0, 2, (bsz,)).astype(np.int32)),
+            w=np.ones((bsz,), np.float32),
+        )
+        # Big enough that real layout differences beat this rig's
+        # scheduler jitter (tiny models drown in it — same sizing
+        # lesson as the fleet bench): ~50-200ms steps, not ~5ms.
+        module = SequenceClassifier(tiny_transformer(
+            d_model=256, d_ff=1024, max_len=seq))
+        spec = ModelSpec(module=module, loss="cross_entropy",
+                         optimizer="adam", optimizer_params={"lr": 1e-3})
+        steps, repeats, top_k = 4, 3, 4
+
+        # ---- the tuner under test ----------------------------------------
+        with tempfile.TemporaryDirectory() as td:
+            artifact = os.path.join(td, "tune_result.json")
+            tuned = autotune(
+                spec, batch, devices, steps=steps, repeats=repeats,
+                measure_top_k=top_k, artifact_path=artifact,
+                telemetry=tele,
+            )
+            # Artifact round-trip: the ranking and prune log must
+            # survive the JSON (what `mesh="auto"` consumers read).
+            loaded = TuneResult.load(artifact)
+        if loaded.to_dict() != tuned.to_dict():
+            raise AssertionError("tune_result.json round-trip mismatch")
+        if not tuned.to_dict()["ranking"]:
+            raise AssertionError("tuner emitted no ranking")
+        pruned = tuned.pruned()
+        if not pruned:
+            raise AssertionError(
+                "prune step eliminated no candidate — the analytic "
+                "comm model did no work"
+            )
+        if any(c.measured for c in pruned):
+            raise AssertionError("a pruned candidate was executed")
+
+        # ---- tuner execution budget --------------------------------------
+        budget_s = float(os.environ.get("SPARKTORCH_TPU_TUNE_BUDGET_S",
+                                        "600"))
+        # The step budget counts EVERY profiled step the tuner ran —
+        # warmup captures included (they execute; discarding their
+        # scores doesn't refund their cost).
+        step_budget = top_k * steps * (repeats + tuned.warmup_rounds)
+        if tuned.executed_steps_total > step_budget:
+            raise AssertionError(
+                f"tuner executed {tuned.executed_steps_total} profiled "
+                f"steps > budget {top_k} x {steps} x "
+                f"({repeats} + {tuned.warmup_rounds} warmup)"
+            )
+        if tuned.wall_s > budget_s:
+            raise AssertionError(
+                f"tuner wall {tuned.wall_s:.1f}s over the {budget_s:.0f}s "
+                f"budget"
+            )
+
+        # ---- the exhaustive referee --------------------------------------
+        jax.clear_caches()
+        gc.collect()
+        exhaustive = autotune(
+            spec, batch, devices, steps=steps, repeats=repeats,
+            exhaustive=True, telemetry=tele,
+        )
+        ex_ranked = exhaustive.ranking()
+        ex_by_label = {c.label: c for c in ex_ranked}
+        winner = ex_ranked[0]
+        chosen_label = tuned.best_label
+
+        tol = float(os.environ.get("SPARKTORCH_TPU_TUNE_TOL", "0.10"))
+        chosen_ex = ex_by_label.get(chosen_label)
+        if chosen_ex is None:
+            raise AssertionError(
+                f"chosen mesh {chosen_label} missing from the exhaustive "
+                f"measurement ({sorted(ex_by_label)})"
+            )
+        winner_wall = float(winner.measured["step_wall_s"])
+        chosen_wall = float(chosen_ex.measured["step_wall_s"])
+        if chosen_label != winner.label and \
+                chosen_wall > winner_wall * (1.0 + tol):
+            raise AssertionError(
+                f"tuner chose {chosen_label} "
+                f"({chosen_wall * 1e3:.2f}ms/step on the exhaustive rig) "
+                f"but the exhaustive winner is {winner.label} "
+                f"({winner_wall * 1e3:.2f}ms/step) — "
+                f"{(chosen_wall / winner_wall - 1) * 100:.1f}% slower, "
+                f"over the {tol * 100:.0f}% tolerance"
+            )
+        # The prune must never eliminate the measured winner — judged
+        # at the same tolerance, because on this rig the top entries
+        # sit inside each other's noise and the "winner" identity is
+        # a coin flip between them: a pruned candidate is a violation
+        # when the exhaustive pass shows it MATERIALLY better than
+        # what the tuner chose.
+        materially_better = [
+            c for c in pruned
+            if c.label in ex_by_label
+            and float(ex_by_label[c.label].measured["step_wall_s"])
+            < chosen_wall / (1.0 + tol)
+        ]
+        if materially_better:
+            raise AssertionError(
+                f"the prune step eliminated candidate(s) materially "
+                f"faster than the chosen {chosen_label} "
+                f"({chosen_wall * 1e3:.2f}ms): "
+                + ", ".join(
+                    f"{c.label} ({float(ex_by_label[c.label].measured['step_wall_s']) * 1e3:.2f}ms)"
+                    for c in materially_better)
+                + f" — the comm model mis-ranked the space "
+                f"(predicted order: "
+                f"{[c.label for c in tuned.candidates]})"
+            )
+
+        return {
+            "config": "mesh_tune", "unit": "chosen step wall vs best (x)",
+            "value": round(chosen_wall / winner_wall, 4),
+            "chosen": chosen_label,
+            "exhaustive_winner": winner.label,
+            "chosen_wall_ms": round(chosen_wall * 1e3, 3),
+            "winner_wall_ms": round(winner_wall * 1e3, 3),
+            "tolerance": tol,
+            "n_candidates": len(tuned.candidates),
+            "n_pruned": len(pruned),
+            "n_measured_tuner": len(tuned.ranking()),
+            "rounds_run": tuned.rounds_run,
+            "early_stopped": tuned.early_stopped,
+            "noise_floor_ms": round(tuned.noise_floor_s * 1e3, 3),
+            "tuner_wall_s": round(tuned.wall_s, 1),
+            "exhaustive_wall_s": round(exhaustive.wall_s, 1),
+            "tuner_ranking": tuned.to_dict()["ranking"],
+            "exhaustive_ranking": [
+                {"mesh": c.label,
+                 "wall_ms": round(float(c.measured["step_wall_s"]) * 1e3, 3),
+                 "exposed": round(float(
+                     c.measured["exposed_comm_fraction"]), 3)}
+                for c in ex_ranked
+            ],
+            "pruned": [{"mesh": c.label, "reason": c.reason}
+                       for c in pruned],
+            "n_chips": n_dev,
+            "wall_s": round(time.perf_counter() - t0, 1),
         }
     finally:
         if jax.default_backend() == "cpu":
@@ -1510,6 +1813,11 @@ def bench_gang_obs(n_ranks: int = 3) -> dict:
             for exp in exporters:
                 exp.stop()
 
+    # ---- gang drift gate (vs the previous round's gang record) -------
+    gang_drift = _check_gang_drift(
+        "gang_obs", float(xp["step_skew_s"]), float(xp["comm_fraction"]),
+    )
+
     return {
         "config": "gang_obs", "unit": "ranks merged",
         "value": n_ranks,
@@ -1518,6 +1826,7 @@ def bench_gang_obs(n_ranks: int = 3) -> dict:
         "gang_step_skew_s": round(float(xp["step_skew_s"]), 6),
         "gang_comm_s": round(float(xp["comm_s"]), 6),
         "gang_comm_fraction": round(float(xp["comm_fraction"]), 4),
+        "gang_drift": gang_drift,
         "merged_series": sum(
             len(merged_snap.get(s, {}))
             for s in ("counters", "gauges", "histograms")
@@ -2077,6 +2386,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "sharded_trace": bench_sharded_trace,
     "gang_obs": bench_gang_obs,
+    "mesh_tune": bench_mesh_tune,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,

@@ -226,6 +226,17 @@ class ShardedTransport:
         self._clients: Dict[str, _ShardClient] = {}
         self._ring: Optional[HashRing] = None
         self._ring_version = -1
+        # ONE push-residual store for the whole fleet, keyed by leaf
+        # PATH and injected into every per-shard transport. Residuals
+        # follow the leaf, not the shard: when add/drain migrates a
+        # leaf to a new owner, its accumulated quantization noise
+        # folds into the next push to the NEW shard instead of
+        # orphaning one window's worth in the old transport. Fan-out
+        # threads touch disjoint paths (ring ownership), so the dict
+        # needs no lock.
+        self._push_residuals: Optional[Dict[Path, np.ndarray]] = (
+            {} if (error_feedback and quant is not None) else None
+        )
         self._leaves: Dict[Path, np.ndarray] = {}
         self._executor: Optional[ThreadPoolExecutor] = None
         self._own = self._fresh_own()
@@ -295,6 +306,7 @@ class ShardedTransport:
                             url, quant=self.quant,
                             error_feedback=self.error_feedback,
                             telemetry=self.telemetry, run_id=self.run_id,
+                            residuals=self._push_residuals,
                             **self._transport_kwargs,
                         ),
                     )
@@ -431,9 +443,11 @@ class ShardedTransport:
 
     def push(self, grads) -> None:
         """Split the gradient tree by ring ownership and scatter the
-        partial trees to their shards in parallel. Per-shard
-        quantization residuals live in each shard's own transport, so
-        error feedback stays exact per tensor."""
+        partial trees to their shards in parallel. Quantization
+        residuals live in ONE path-keyed store shared by every shard
+        transport (see ``_push_residuals``), so error feedback stays
+        exact per tensor even across a reshard that migrates the leaf
+        to a different owner."""
         st = self._own
         t0 = time.perf_counter()
         host = _tree_to_host(grads)

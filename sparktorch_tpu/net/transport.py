@@ -79,7 +79,9 @@ class BinaryTransport:
                  pull_timeout: float = _PULL_TIMEOUT,
                  retries: int = 3, backoff_s: float = 0.05,
                  deadline_s: Optional[float] = _RECONNECT_DEADLINE,
-                 telemetry=None, run_id: Optional[str] = None):
+                 telemetry=None, run_id: Optional[str] = None,
+                 residuals: Optional[Dict[Tuple[str, ...],
+                                          np.ndarray]] = None):
         parts = urlsplit(url if "//" in url else f"http://{url}")
         if parts.scheme not in ("", "http"):
             raise ValueError(f"BinaryTransport speaks http only, got {url!r}")
@@ -90,8 +92,14 @@ class BinaryTransport:
         self.quant = quant
         # Error-feedback residuals, path -> np.ndarray. bf16's residual
         # is small but free to track; int8 genuinely needs it.
+        # ``residuals`` lets an owner inject a SHARED path-keyed store:
+        # the sharded fan-out keys residuals by leaf path at the
+        # ShardedTransport level, so a leaf that migrates between
+        # shards on add/drain keeps its accumulated noise instead of
+        # orphaning it in the old shard's transport.
         self._residuals: Optional[Dict[Tuple[str, ...], np.ndarray]] = (
-            {} if (error_feedback and quant is not None) else None
+            residuals if residuals is not None
+            else ({} if (error_feedback and quant is not None) else None)
         )
         self.timeout = timeout
         self.pull_timeout = pull_timeout

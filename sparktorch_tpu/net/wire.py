@@ -259,7 +259,14 @@ def quantize_tree(
                 new_residuals[path] = err
             leaves.append((path, qleaf))
     if residuals is not None:
-        residuals.clear()
+        # Update, never residuals.clear(): the sharded transport
+        # shares ONE path-keyed store across per-shard partial pushes,
+        # and a whole-store clear on shard A's partial would wipe
+        # shard B's (and every migrated leaf's) accumulated noise.
+        # Every float leaf of THIS call lands in new_residuals (floats
+        # always quantize; int/empty leaves never hold residuals), so
+        # the update alone replaces exactly this call's entries;
+        # entries for paths that left the tree go stale and harmless.
         residuals.update(new_residuals)
     return leaves, (residuals if residuals is not None else {})
 

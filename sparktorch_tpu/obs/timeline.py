@@ -188,6 +188,78 @@ def render_gang_report(gang: Any) -> str:
     return "\n".join(lines) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# Auto-tune rendering (the search's ranking + prune decisions)
+# ---------------------------------------------------------------------------
+
+
+def render_tune_report(doc: Dict[str, Any]) -> str:
+    """Render a tune artifact (``tune_result.json`` or the
+    ``xprof_tune`` snapshot section): the measured ranking as wall
+    bars, then the prune/skip decisions — so "why did the tuner pick
+    this mesh, and what did it refuse to run" is one terminal page."""
+    cands = [dict(c) for c in doc.get("candidates", [])]
+    measured = [c for c in cands if c.get("status") == "measured"
+                and c.get("measured")]
+    measured.sort(key=lambda c: c.get("score") or 0.0)
+    best = doc.get("best_label", "?")
+    lines = [
+        f"mesh auto-tune: {doc.get('n_devices', '?')} devices, "
+        f"global batch {doc.get('global_batch', '?')}"
+        + (f"   run: {doc['run_id']}" if doc.get("run_id") else ""),
+        f"chosen: {best}   candidates: {len(cands)}"
+        f" ({len(measured)} measured,"
+        f" {sum(c.get('status') == 'pruned' for c in cands)} pruned,"
+        f" {sum(c.get('status') == 'failed' for c in cands)} failed)"
+        + ("   [early stop]" if doc.get("early_stopped") else ""),
+        f"noise floor: {_fmt_ms(doc.get('noise_floor_s', 0.0))}"
+        f"   search wall: {doc.get('wall_s', 0.0):.1f}s",
+        "",
+    ]
+    if measured:
+        worst = max(float(c["measured"].get("step_wall_s", 0.0))
+                    for c in measured) or 1.0
+        lines.append(
+            f"{'mesh':>18} {'step wall':>10} {'exposed%':>9}"
+            f" {'ovl%':>6} {'score':>10}  wall (vs slowest measured)"
+        )
+        for c in measured:
+            m = c["measured"]
+            wall = float(m.get("step_wall_s", 0.0))
+            bar = "#" * max(int(round(_BAR_W * wall / worst)), 1)
+            mark = " <- chosen" if c.get("label") == best else ""
+            lines.append(
+                f"{c.get('label', '?'):>18} {_fmt_ms(wall):>10}"
+                f" {100 * float(m.get('exposed_comm_fraction', 0.0)):>8.1f}"
+                f" {100 * float(m.get('overlap_fraction', 0.0)):>5.1f}"
+                f" {_fmt_ms(float(c.get('score') or 0.0)):>10}"
+                f"  {bar}{mark}"
+            )
+    not_run = [c for c in cands
+               if c.get("status") not in ("measured", None)]
+    if not_run:
+        lines.append("")
+        lines.append("not measured:")
+        for c in not_run:
+            pred = (c.get("predicted") or {})
+            cost = pred.get("total_cost", pred.get("total_bytes", 0.0))
+            lines.append(
+                f"  {c.get('label', '?'):<18} {c.get('status'):<8}"
+                f" pred {float(cost) / 1e6:>8.2f}MB-eq  {c.get('reason', '')}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def _tune_from_jsonl(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The last tune search in a telemetry dump (the ``xprof_tune``
+    snapshot section a ``TuneResult.publish`` leaves behind)."""
+    for rec in reversed(records):
+        section = (rec.get("sections") or {}).get("xprof_tune")
+        if isinstance(section, dict) and section.get("candidates"):
+            return section
+    return None
+
+
 def _gang_from_jsonl(records: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     """The last merged gang budget in a collector sink (or a dumped
     collector snapshot): ``sections.xprof_gang`` on snapshot-shaped
@@ -289,6 +361,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--gang", action="store_true",
                         help="render the whole-gang view: per-rank "
                              "lanes, cross-rank skew annotations")
+    parser.add_argument("--tune", action="store_true",
+                        help="render a mesh auto-tune artifact "
+                             "(tune_result.json, or a telemetry JSONL "
+                             "carrying the xprof_tune section): "
+                             "measured ranking + prune decisions")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis dict as JSON")
     parser.add_argument("--top", type=int, default=10,
@@ -298,6 +375,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     args.path = args.paths[0]
 
+    if args.gang and args.tune:
+        print("error: --gang and --tune are different reports; pick one")
+        return 2
+    if args.tune:
+        return _main_tune(args)
     if args.gang:
         return _main_gang(args)
     if len(args.paths) > 1:
@@ -331,6 +413,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(analysis.to_dict()))
     else:
         print(render_report(analysis, top=args.top), end="")
+    return 0
+
+
+def _main_tune(args) -> int:
+    """--tune: a tune_result.json artifact, or a telemetry JSONL dump
+    whose last snapshot carries the xprof_tune section."""
+    if len(args.paths) > 1:
+        print("error: --tune renders one artifact at a time")
+        return 2
+    path = args.paths[0]
+    if _looks_like_jsonl(path):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(path)
+        except OSError as e:
+            print(f"error: {e}")
+            return 1
+        doc = _tune_from_jsonl(records)
+        if doc is None:
+            print(f"no tune search (sections.xprof_tune) in {path}")
+            return 1
+    else:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}")
+            return 1
+        if not isinstance(doc, dict) or doc.get("kind") != "tune":
+            print(f"error: {path} is not a tune artifact "
+                  f"(kind != 'tune')")
+            return 1
+    print(json.dumps(doc) if args.json else render_tune_report(doc),
+          end="" if not args.json else "\n")
     return 0
 
 

@@ -1,0 +1,1060 @@
+"""Trace-guided auto-tuning of mesh/parallelism configs.
+
+The repo can *measure* exactly where step time goes (per-collective
+comm/compute/overlap budgets from :mod:`sparktorch_tpu.obs.xprof`) and
+can *run* every dp/fsdp/tp/sp/ep mesh combination — but picking the
+mesh for a workload was still a human. This module closes the loop,
+Alpa/AutoSharding-style but grounded in MEASURED traces rather than a
+static cost model alone:
+
+1. **Enumerate** every legal :class:`MeshConfig` for the device count:
+   axis products must divide the device world, and each axis is capped
+   by the model dims the sharding rules lay out over it (``tp`` must
+   divide heads/FFN/vocab, ``sp`` the sequence, ``ep`` the expert
+   count, the batch axes the global batch).
+2. **Prune** the space with a cheap analytic comm-volume model — bytes
+   moved per step per candidate from param/activation shapes, no
+   execution. The model is a PRUNER, not a predictor: it only has to
+   rank badly-communicating layouts below plausible ones.
+3. **Measure** the survivors: compile every survivor once (outside
+   any capture — a capture containing the multi-second XLA compile
+   overflows the profiler buffer), then run INTERLEAVED rounds of a
+   few profiled steps per candidate — the same
+   medians-over-interleaved-repeats discipline the fleet bench uses,
+   because on a cpu-share rig whole measurement windows land in slow
+   scheduler epochs and back-to-back candidate timings swing 10x.
+   Each round's capture is analyzed offline
+   (:class:`~sparktorch_tpu.obs.xprof.TraceAnalysis`); candidates are
+   scored by the median step wall across all rounds with an
+   exposed-comm tiebreak, and the round loop early-stops once the
+   best candidate's lead exceeds the measurement noise floor (the
+   cross-candidate max of p75-p25 step-wall spreads).
+4. **Emit** the search as an artifact (``tune_result.json``: full
+   ranking, per-candidate budgets, prune decisions, chosen mesh) and
+   as an ``xprof_tune`` telemetry section + ``xprof.tune_*`` metrics,
+   so the collector and ``obs.timeline --tune`` can render it.
+
+The winner is a usable fast path, not a report:
+``make_sharded_train_step(mesh="auto", spec=..., sample_batch=...)``
+runs this search and trains on the chosen mesh
+(:mod:`sparktorch_tpu.train.sharded`), and ``make bench-tune`` gates
+the tuner against an exhaustive measurement of the same space.
+
+CLI::
+
+    python -m sparktorch_tpu.parallel.tune --model tiny --batch 32 \
+        --out tune_result.json
+
+Everything through step (2) is backend-free (no device execution), so
+enumeration, pruning, and scoring are tier-1-testable on synthetic
+shapes; only :func:`measure_candidate` touches the accelerator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from sparktorch_tpu.obs.log import get_logger
+from sparktorch_tpu.parallel.mesh import ALL_AXES, AXIS_DP, MeshConfig
+
+_LOG = get_logger("sparktorch_tpu.parallel.tune")
+
+# The GSPMD sharded trainer has no pipeline schedule, so ``pp`` stays 1
+# in the default search space (a pp>1 mesh there only starves the
+# batch axes). The pipeline trainer's own search can opt it back in.
+DEFAULT_AXES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp", "ep")
+
+ARTIFACT_KIND = "tune"
+
+
+# ---------------------------------------------------------------------------
+# Search space: legal MeshConfig candidates
+# ---------------------------------------------------------------------------
+
+
+def transformer_caps(cfg, seq_len: Optional[int] = None) -> Dict[str, Tuple[int, ...]]:
+    """Per-axis divisibility caps for a :class:`TransformerConfig`,
+    mirroring what :mod:`sparktorch_tpu.parallel.sharding_rules`
+    actually lays out over each axis: an axis size is legal iff it
+    divides EVERY listed dim (``_spec_fits`` would otherwise silently
+    fall back to replication and the axis would waste devices).
+
+    - ``tp``: qkv heads, the FFN inner dim, and the vocab (embedding
+      rows ride ``P(tp, fsdp)``);
+    - ``fsdp``: the model dim (the embedding's fsdp-sharded column);
+    - ``sp``: the sequence length;
+    - ``ep``: the expert count (dense model -> ep stays 1);
+    - ``pp``: the layer count.
+    """
+    return {
+        "fsdp": (cfg.d_model,),
+        "tp": (cfg.n_heads, cfg.d_ff, cfg.vocab_size),
+        "sp": (int(seq_len or cfg.max_len),),
+        "ep": (cfg.n_experts,) if cfg.n_experts > 0 else (1,),
+        "pp": (cfg.n_layers,),
+    }
+
+
+def _legal(axis_size: int, dims: Sequence[int]) -> bool:
+    return all(d > 0 and d % axis_size == 0 for d in dims) if dims \
+        else True
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_candidates(
+    n_devices: int,
+    caps: Mapping[str, Sequence[int]],
+    global_batch: int,
+    axes: Sequence[str] = DEFAULT_AXES,
+    max_candidates: Optional[int] = None,
+) -> List[MeshConfig]:
+    """Every legal :class:`MeshConfig` for ``n_devices``: the non-dp
+    axis product divides the device count (dp absorbs the rest), each
+    axis size divides its cap dims, and the batch axes (dp*fsdp)
+    divide the global batch. Deterministic order: ascending by the
+    (fsdp, tp, sp, ep, pp) size tuple, so the pure-dp config is always
+    candidate 0 and goldens can assert exact lists."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    axes = tuple(axes)
+    for ax in axes:
+        if ax not in ALL_AXES:
+            raise ValueError(f"unknown mesh axis {ax!r} (of {ALL_AXES})")
+    fixed_axes = [a for a in ALL_AXES if a != AXIS_DP]
+    choices: Dict[str, List[int]] = {}
+    for ax in fixed_axes:
+        if ax not in axes:
+            choices[ax] = [1]
+            continue
+        choices[ax] = [d for d in _divisors(n_devices)
+                       if _legal(d, tuple(caps.get(ax, ())))]
+
+    out: List[MeshConfig] = []
+    import itertools
+
+    for combo in itertools.product(*(choices[a] for a in fixed_axes)):
+        fixed = math.prod(combo)
+        if n_devices % fixed != 0:
+            continue
+        dp = n_devices // fixed
+        if AXIS_DP not in axes and dp != 1:
+            continue
+        sizes = dict(zip(fixed_axes, combo))
+        if global_batch % (dp * sizes["fsdp"]) != 0:
+            continue
+        if not _legal(dp, tuple(caps.get(AXIS_DP, ()))):
+            continue
+        out.append(MeshConfig(dp=dp, **sizes))
+    out.sort(key=lambda c: (c.fsdp, c.tp, c.sp, c.ep, c.pp))
+    if max_candidates is not None and len(out) > max_candidates:
+        # Truncation here is in ENUMERATION order, blind to cost —
+        # callers that can rank first (autotune does) should cap
+        # after the cost model instead.
+        _LOG.warning(
+            f"[sparktorch_tpu:tune] enumeration truncated "
+            f"{len(out)} -> {max_candidates} candidates "
+            f"(enumeration order, not cost order)"
+        )
+        out = out[:max_candidates]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Analytic comm-volume model (the pruner — no execution)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadShape:
+    """Byte-level skeleton of one training step, enough to rank mesh
+    candidates by communication volume without running anything.
+
+    ``param_bytes`` is the FULL (unsharded) parameter footprint;
+    ``tp_param_bytes`` the subset the sharding rules lay out over
+    ``tp`` (the big matmul weights — for a transformer, nearly all of
+    it). Activations are modeled as ``tokens x d_model`` blocks."""
+
+    param_bytes: float
+    tp_param_bytes: float = 0.0
+    global_batch: int = 1
+    seq_len: int = 1
+    d_model: int = 1
+    n_layers: int = 1
+    n_moe_layers: int = 0
+    dtype_bytes: int = 4
+
+
+def transformer_workload(cfg, global_batch: int,
+                         seq_len: Optional[int] = None) -> WorkloadShape:
+    """Analytic parameter/activation shape for a transformer config
+    (counts the matmul weights; biases/layernorms are noise at this
+    resolution)."""
+    d, ff, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    moe = sum(cfg.moe_pattern()) if cfg.n_experts > 0 else 0
+    dense = cfg.n_layers - moe
+    per_dense = 4 * d * d + 2 * d * ff
+    per_moe = 4 * d * d + cfg.n_experts * 2 * d * ff
+    matmul_params = v * d + dense * per_dense + moe * per_moe
+    dtype = 4  # params/grads travel f32 on the wire-level collectives
+    return WorkloadShape(
+        param_bytes=float(matmul_params) * dtype,
+        tp_param_bytes=float(matmul_params) * dtype,
+        global_batch=int(global_batch),
+        seq_len=int(seq_len or cfg.max_len),
+        d_model=d,
+        n_layers=cfg.n_layers,
+        n_moe_layers=moe,
+        dtype_bytes=dtype,
+    )
+
+
+# Per-collective launch/rendezvous latency expressed in EQUIVALENT
+# BYTES (the LogP alpha/beta ratio: latency x bandwidth). Small-tensor
+# workloads are latency-bound — a pure byte count would rank a config
+# with 4 tiny activation all-reduces per layer "cheaper" than one
+# bucketed gradient all-reduce and prune the actual winner. The CPU
+# rig's in-process rendezvous is orders slower than ICI, hence the
+# much larger equivalent.
+DEFAULT_ALPHA_BYTES = {"cpu": 1 << 20, "gpu": 1 << 18, "tpu": 1 << 17}
+
+
+def alpha_bytes_for_backend(backend: Optional[str] = None) -> float:
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    return float(DEFAULT_ALPHA_BYTES.get(backend,
+                                         DEFAULT_ALPHA_BYTES["tpu"]))
+
+
+def predict_comm_bytes(config: MeshConfig, shape: WorkloadShape,
+                       n_devices: int,
+                       alpha_bytes: float = 0.0) -> Dict[str, float]:
+    """Communication cost of ONE step of ``shape`` under ``config`` —
+    ring/bidirectional collective byte models summed over devices,
+    plus an alpha term (``alpha_bytes`` equivalent bytes per logical
+    collective) for launch/rendezvous latency. Returns per-mechanism
+    byte totals, the ``collective_ops`` count, ``total_bytes`` (beta
+    term only), and ``total_cost`` (the prune key: bytes + alpha).
+
+    Deliberately coarse (no link topology, no overlap): its one job
+    is a monotone ranking — more replicated gradient bytes, more
+    exposed activation traffic, or more collective launches MUST
+    predict more comm — so the pruner never has to execute the
+    obviously-worst layouts. The measured phase owns the final
+    ranking."""
+    sizes = config.resolve(n_devices)
+    dp, fsdp, tp = sizes["dp"], sizes["fsdp"], sizes["tp"]
+    sp, ep, pp = sizes["sp"], sizes["ep"], sizes["pp"]
+
+    # Per-device parameter/gradient residency after layout: with
+    # tp>1 the rule-matched weights shard over tp; EVERYTHING not
+    # tp-sharded (including those same weights when tp==1) falls back
+    # to fsdp sharding.
+    tp_bytes = shape.tp_param_bytes if tp > 1 else 0.0
+    rest_bytes = max(shape.param_bytes - tp_bytes, 0.0)
+    grad_dev = tp_bytes / tp + rest_bytes / fsdp
+
+    # Activation block per device: the tokens this device computes.
+    tokens_dev = (shape.global_batch / (dp * fsdp)) * (shape.seq_len / sp)
+    act_dev = tokens_dev * shape.d_model * shape.dtype_bytes
+
+    per_dev = {
+        # dp gradient ring all-reduce of the per-device grad shard.
+        "dp_all_reduce": (2.0 * (dp - 1) / dp) * grad_dev if dp > 1 else 0.0,
+        # fsdp: param all-gather (fwd) + grad reduce-scatter (bwd).
+        "fsdp_gather_scatter": (2.0 * (fsdp - 1) / fsdp) * rest_bytes
+        if fsdp > 1 else 0.0,
+        # tp: two activation all-reduces per layer (attn-out, mlp-out).
+        "tp_all_reduce": shape.n_layers * 2 * (2.0 * (tp - 1) / tp) * act_dev
+        if tp > 1 else 0.0,
+        # sp: ring-attention k/v block rotation, (sp-1) hops per layer.
+        "sp_ppermute": shape.n_layers * (sp - 1) * 2.0 * act_dev
+        if sp > 1 else 0.0,
+        # ep: dispatch + combine all-to-alls per MoE layer.
+        "ep_all_to_all": shape.n_moe_layers * 2 * ((ep - 1) / ep) * act_dev
+        if ep > 1 else 0.0,
+        # pp: stage-boundary activation sends, fwd + bwd.
+        "pp_send_recv": 2.0 * ((pp - 1) / pp) * act_dev if pp > 1 else 0.0,
+    }
+    out = {k: n_devices * v for k, v in per_dev.items()}
+    out["total_bytes"] = sum(out.values())
+    # Logical collective launches per step (the alpha term's count):
+    # the bucketed dp grad reduction is ONE launch; tp pays two per
+    # layer; sp pays one ppermute per ring hop per layer.
+    ops = (
+        (1 if dp > 1 else 0)
+        + (2 if fsdp > 1 else 0)
+        + (shape.n_layers * 2 if tp > 1 else 0)
+        + (shape.n_layers * (sp - 1) if sp > 1 else 0)
+        + (shape.n_moe_layers * 2 if ep > 1 else 0)
+        + (2 * (pp - 1) if pp > 1 else 0)
+    )
+    out["collective_ops"] = float(ops)
+    out["total_cost"] = out["total_bytes"] + float(alpha_bytes) * ops
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Candidates and results
+# ---------------------------------------------------------------------------
+
+
+# Candidate fates. Note there is no "skipped": the early stop ends
+# the ROUND loop (every surviving candidate keeps its rounds so far),
+# it never leaves a candidate half-decided.
+STATUS_MEASURED = "measured"
+STATUS_PRUNED = "pruned"
+STATUS_FAILED = "failed"
+
+
+def mesh_label(sizes: Mapping[str, int]) -> str:
+    """Compact prom-label-safe spelling: ``dp4xtp2`` (axes of size 1
+    omitted; the trivial mesh is ``dp1``)."""
+    parts = [f"{a}{sizes[a]}" for a in ALL_AXES if sizes.get(a, 1) > 1]
+    return "x".join(parts) if parts else "dp1"
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One point of the search space and everything decided about it."""
+
+    axes: Dict[str, int]
+    predicted: Dict[str, float]
+    status: str = "pending"
+    reason: Optional[str] = None
+    measured: Optional[Dict[str, Any]] = None
+    score: Optional[float] = None
+
+    @property
+    def predicted_bytes(self) -> float:
+        return float(self.predicted.get("total_bytes", 0.0))
+
+    @property
+    def predicted_cost(self) -> float:
+        """The prune key: beta (bytes) + alpha (launch) terms."""
+        return float(self.predicted.get("total_cost",
+                                        self.predicted_bytes))
+
+    @property
+    def label(self) -> str:
+        return mesh_label(self.axes)
+
+    def mesh_config(self) -> MeshConfig:
+        sizes = {a: int(self.axes.get(a, 1)) for a in ALL_AXES}
+        return MeshConfig(**sizes)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "axes": dict(self.axes),
+            "label": self.label,
+            "predicted": {k: round(float(v), 2)
+                          for k, v in self.predicted.items()},
+            "status": self.status,
+            "reason": self.reason,
+            "measured": dict(self.measured) if self.measured else None,
+            "score": self.score,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Candidate":
+        return cls(
+            axes={k: int(v) for k, v in (d.get("axes") or {}).items()},
+            predicted=dict(d.get("predicted") or {}),
+            status=str(d.get("status", "pending")),
+            reason=d.get("reason"),
+            measured=dict(d["measured"]) if d.get("measured") else None,
+            score=d.get("score"),
+        )
+
+
+@dataclasses.dataclass
+class TuneResult:
+    """The whole search: every candidate with its fate, the winner,
+    and the bookkeeping a gate needs to audit the decision."""
+
+    n_devices: int
+    global_batch: int
+    best: Dict[str, int]
+    candidates: List[Candidate]
+    noise_floor_s: float
+    early_stopped: bool
+    steps_per_candidate: int     # profiled steps per candidate PER ROUND
+    wall_s: float
+    exposed_weight: float
+    rounds_run: int = 0          # scored interleaved rounds executed
+    warmup_rounds: int = 0       # discarded warmup rounds per candidate
+    executed_steps_total: int = 0  # ALL profiled steps run, incl. warmup
+    candidates_dropped: int = 0  # past the max_candidates cap (logged)
+    caps: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    run_id: Optional[str] = None
+
+    def best_config(self) -> MeshConfig:
+        sizes = {a: int(self.best.get(a, 1)) for a in ALL_AXES}
+        return MeshConfig(**sizes)
+
+    @property
+    def best_label(self) -> str:
+        return mesh_label(self.best)
+
+    def ranking(self) -> List[Candidate]:
+        """Measured candidates, best (lowest score) first."""
+        measured = [c for c in self.candidates
+                    if c.status == STATUS_MEASURED and c.score is not None]
+        return sorted(measured, key=lambda c: c.score)
+
+    def pruned(self) -> List[Candidate]:
+        return [c for c in self.candidates if c.status == STATUS_PRUNED]
+
+    def measured_steps_total(self) -> int:
+        return sum(
+            int((c.measured or {}).get("n_steps", 0))
+            for c in self.candidates if c.status == STATUS_MEASURED
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": ARTIFACT_KIND,
+            "run_id": self.run_id,
+            "n_devices": self.n_devices,
+            "global_batch": self.global_batch,
+            "best": dict(self.best),
+            "best_label": self.best_label,
+            "noise_floor_s": self.noise_floor_s,
+            "early_stopped": self.early_stopped,
+            "steps_per_candidate": self.steps_per_candidate,
+            "rounds_run": self.rounds_run,
+            "warmup_rounds": self.warmup_rounds,
+            "measured_steps_total": self.measured_steps_total(),
+            "executed_steps_total": self.executed_steps_total,
+            "candidates_dropped": self.candidates_dropped,
+            "wall_s": self.wall_s,
+            "exposed_weight": self.exposed_weight,
+            "caps": {k: list(v) for k, v in self.caps.items()},
+            "n_candidates": len(self.candidates),
+            "n_measured": sum(c.status == STATUS_MEASURED
+                              for c in self.candidates),
+            "n_pruned": sum(c.status == STATUS_PRUNED
+                            for c in self.candidates),
+            "ranking": [c.label for c in self.ranking()],
+            "candidates": [c.to_dict() for c in self.candidates],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "TuneResult":
+        if d.get("kind") != ARTIFACT_KIND:
+            raise ValueError(
+                f"not a tune artifact (kind={d.get('kind')!r})"
+            )
+        return cls(
+            n_devices=int(d["n_devices"]),
+            global_batch=int(d["global_batch"]),
+            best={k: int(v) for k, v in d["best"].items()},
+            candidates=[Candidate.from_dict(c)
+                        for c in d.get("candidates", [])],
+            noise_floor_s=float(d.get("noise_floor_s", 0.0)),
+            early_stopped=bool(d.get("early_stopped", False)),
+            steps_per_candidate=int(d.get("steps_per_candidate", 0)),
+            rounds_run=int(d.get("rounds_run", 0)),
+            warmup_rounds=int(d.get("warmup_rounds", 0)),
+            executed_steps_total=int(d.get("executed_steps_total", 0)),
+            candidates_dropped=int(d.get("candidates_dropped", 0)),
+            wall_s=float(d.get("wall_s", 0.0)),
+            exposed_weight=float(d.get("exposed_weight", 0.0)),
+            caps={k: [int(x) for x in v]
+                  for k, v in (d.get("caps") or {}).items()},
+            run_id=d.get("run_id"),
+        )
+
+    def save(self, path: str) -> str:
+        """Write the ``tune_result.json`` artifact atomically (tmp +
+        rename: a killed tuner must not leave a torn artifact that a
+        later ``mesh="auto"`` run half-parses)."""
+        import os
+
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)  # lint-obs: ok (tune artifact persistence, not telemetry)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "TuneResult":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # -- bus publication ---------------------------------------------------
+
+    def publish(self, telemetry=None) -> None:
+        """Put the search on the telemetry bus under ``xprof.tune_*``
+        names (the same contract as
+        :meth:`~sparktorch_tpu.obs.xprof.TraceAnalysis.publish`):
+        per-candidate wall samples, outcome counters, winner gauges,
+        one condensed ``xprof_tune`` event, and the full document as
+        the ``xprof_tune`` snapshot section — so a ``/telemetry``
+        scrape, a collector merge, and ``obs.timeline --tune`` all
+        render the same search."""
+        from sparktorch_tpu.obs.telemetry import get_telemetry
+
+        tele = telemetry or get_telemetry()
+        for c in self.candidates:
+            tele.counter("xprof.tune_candidates_total",
+                         labels={"outcome": c.status})
+            if c.status == STATUS_MEASURED and c.measured:
+                tele.observe("xprof.tune_candidate_step_wall_s",
+                             float(c.measured.get("step_wall_s", 0.0)),
+                             labels={"mesh": c.label})
+        tele.counter("xprof.tune_runs_total")
+        best = self.ranking()
+        if best:
+            tele.gauge("xprof.tune_best_step_wall_s",
+                       float(best[0].measured.get("step_wall_s", 0.0)))
+            tele.gauge("xprof.tune_best_exposed_fraction",
+                       float(best[0].measured.get(
+                           "exposed_comm_fraction", 0.0)))
+        tele.gauge("xprof.tune_noise_floor_s", self.noise_floor_s)
+        tele.gauge("xprof.tune_wall_s", self.wall_s)
+        tele.event(
+            "xprof_tune",
+            best=self.best_label,
+            n_candidates=len(self.candidates),
+            n_measured=sum(c.status == STATUS_MEASURED
+                           for c in self.candidates),
+            n_pruned=sum(c.status == STATUS_PRUNED
+                         for c in self.candidates),
+            early_stopped=self.early_stopped,
+            noise_floor_s=self.noise_floor_s,
+            wall_s=self.wall_s,
+            ranking=[c.label for c in self.ranking()][:8],
+        )
+        tele.set_section("xprof_tune", self.to_dict())
+
+
+# ---------------------------------------------------------------------------
+# Scoring (the xprof hook)
+# ---------------------------------------------------------------------------
+
+
+def score_wall(median_wall_s: float, exposed_fraction: float,
+               exposed_weight: float) -> float:
+    """THE scoring formula — LOWER is better. The decision variable
+    is the median step wall (robust to one GC pause on a noisy rig);
+    the exposed-comm fraction rides as a multiplicative penalty
+    (``wall * (1 + w * exposed)``) so that two configs inside each
+    other's noise tie-break toward the one whose collectives hide
+    under compute — that one keeps its rank when compute grows.
+    Shared by :func:`score_analysis` (single capture — what the
+    golden-fixture test pins) and the interleaved-round aggregation
+    (:func:`_aggregate_rounds` — the production decision path), so
+    the pinned formula IS the deciding one."""
+    return median_wall_s * (1.0 + exposed_weight * exposed_fraction)
+
+
+def score_analysis(analysis, exposed_weight: float = 0.25
+                   ) -> Tuple[float, Dict[str, Any]]:
+    """Score one candidate's :class:`TraceAnalysis` via
+    :func:`score_wall`. Returns ``(score, measured_record)``."""
+    stats = analysis.step_wall_stats()
+    exposed = analysis.exposed_comm_fraction
+    score = score_wall(stats["median_s"], exposed, exposed_weight)
+    measured = {
+        "step_wall_s": stats["median_s"],
+        "step_wall_mean_s": stats["mean_s"],
+        "spread_s": stats["spread_s"],
+        "n_steps": stats["n"],
+        "comm_fraction": analysis.comm_fraction,
+        "overlap_fraction": analysis.overlap_fraction,
+        "exposed_comm_fraction": exposed,
+        "comm_s": analysis.comm_s,
+        "compute_s": analysis.compute_s,
+        "n_collective_events": analysis.n_collective_events,
+        "collective_counts": analysis.family_counts(),
+    }
+    return score, measured
+
+
+# ---------------------------------------------------------------------------
+# Measurement (the only part that touches the accelerator)
+# ---------------------------------------------------------------------------
+
+
+def prepare_candidate(spec, config: MeshConfig, batch, devices,
+                      tx=None, seq_sharded: bool = False,
+                      telemetry=None) -> Callable[[int], Dict[str, Any]]:
+    """Compile ``spec`` under ``config`` and return a ROUND RUNNER:
+    ``runner(steps)`` captures one fresh XLA profile around ``steps``
+    train steps (state carried across rounds), analyzes it offline,
+    and returns the round record (``walls`` per step, comm/overlap/
+    exposed fractions, collective counts). Compilation happens here,
+    OUTSIDE any capture — a capture containing the multi-second XLA
+    compile floods the profiler buffer and the step markers vanish
+    (see obs/xprof WATCH note). Raises on compile failure (the caller
+    records the candidate as failed and moves on). The runner carries
+    ``runner.compile_s``."""
+    import tempfile
+
+    import jax
+
+    from sparktorch_tpu.obs.xprof import analyze_trace
+    from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
+    from sparktorch_tpu.parallel.mesh import build_mesh
+    from sparktorch_tpu.train.sharded import (
+        create_sharded_state,
+        make_sharded_train_step,
+        shard_batch,
+    )
+    from sparktorch_tpu.utils.tracing import profile_run
+
+    tx = tx or spec.make_optimizer()
+    module = spec.make_module()
+    mesh = build_mesh(config, devices)
+    t0 = time.perf_counter()
+    state, shardings = create_sharded_state(
+        spec, mesh, jax.random.key(0), sample_x=batch.x[:1], tx=tx,
+    )
+    # No profile_dir here: the runner owns its per-round captures.
+    step = make_sharded_train_step(
+        module.apply, spec.loss_fn(), tx, mesh, shardings,
+        seq_sharded=seq_sharded, telemetry=telemetry,
+    )
+    sharded = shard_batch(batch, mesh, seq_sharded=seq_sharded)
+    with _set_mesh(mesh):
+        state, m = step.jitted(state, sharded)  # compile, uncaptured
+    jax.block_until_ready(m.loss)
+    compile_s = time.perf_counter() - t0
+    carried = {"state": state}
+
+    def runner(steps: int) -> Dict[str, Any]:
+        with tempfile.TemporaryDirectory() as profile_dir:
+            # analyze=False: 1 capture per (candidate, round) — the
+            # per-round budgets aggregate into ONE published tune
+            # record; auto-publishing every capture would spam the
+            # xprof.* series with per-round samples.
+            with profile_run(profile_dir, telemetry=telemetry,
+                             analyze=False):
+                st = carried["state"]
+                for _ in range(steps):
+                    st, metrics = step(st, sharded)
+                    # Drain per step so each step's device work lands
+                    # inside its own attribution slice.
+                    jax.block_until_ready(metrics.loss)
+                carried["state"] = st
+            analysis = analyze_trace(profile_dir)
+        if not analysis.steps:
+            raise RuntimeError("profiler emitted no usable capture")
+        return {
+            "walls": [s.wall_s for s in analysis.steps],
+            "comm_fraction": analysis.comm_fraction,
+            "overlap_fraction": analysis.overlap_fraction,
+            "exposed_comm_fraction": analysis.exposed_comm_fraction,
+            "n_collective_events": analysis.n_collective_events,
+            "counts": analysis.family_counts(),
+            "loss": float(metrics.loss),
+        }
+
+    runner.compile_s = compile_s
+    return runner
+
+
+def _aggregate_rounds(rounds: List[Dict[str, Any]], compile_s: float,
+                      exposed_weight: float
+                      ) -> Tuple[float, Dict[str, Any]]:
+    """Fold a candidate's round records into ``(score, measured)`` —
+    the same formula as :func:`score_analysis`, over the pooled
+    walls."""
+    from sparktorch_tpu.obs.xprof import wall_stats
+
+    walls = [w for r in rounds for w in r["walls"]]
+    stats = wall_stats(walls)
+    exposed = sum(r["exposed_comm_fraction"] for r in rounds) / len(rounds)
+    score = score_wall(stats["median_s"], exposed, exposed_weight)
+    counts: Dict[str, int] = {}
+    for r in rounds:
+        for fam, n in (r.get("counts") or {}).items():
+            counts[fam] = counts.get(fam, 0) + int(n)
+    measured = {
+        "step_wall_s": stats["median_s"],
+        "spread_s": stats["spread_s"],
+        "n_steps": stats["n"],
+        "rounds": len(rounds),
+        "comm_fraction": sum(r["comm_fraction"]
+                             for r in rounds) / len(rounds),
+        "overlap_fraction": sum(r["overlap_fraction"]
+                                for r in rounds) / len(rounds),
+        "exposed_comm_fraction": exposed,
+        "n_collective_events": sum(r["n_collective_events"]
+                                   for r in rounds),
+        "collective_counts": counts,
+        "compile_s": compile_s,
+    }
+    return score, measured
+
+
+# ---------------------------------------------------------------------------
+# The driver
+# ---------------------------------------------------------------------------
+
+
+def workload_for(spec, batch, seq_len: Optional[int] = None
+                 ) -> Tuple[WorkloadShape, Optional[Any]]:
+    """(WorkloadShape, transformer config or None) for a ModelSpec +
+    representative batch. Transformer modules get the analytic shape;
+    anything else gets its parameter bytes from an abstract init trace
+    (``jax.eval_shape`` — no device execution) with no tp share."""
+    module = spec.make_module()
+    cfg = getattr(module, "config", None)
+    global_batch = int(batch.x.shape[0])
+    if cfg is not None and hasattr(cfg, "d_model"):
+        seq = seq_len or (batch.x.shape[1] if batch.x.ndim >= 2
+                          else cfg.max_len)
+        return transformer_workload(cfg, global_batch, seq), cfg
+    import jax
+    import numpy as np
+
+    abstract = jax.eval_shape(
+        lambda k: module.init(k, np.asarray(batch.x[:1])),
+        jax.random.key(0),
+    )
+    param_bytes = float(sum(
+        math.prod(leaf.shape) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(abstract)
+    ))
+    return WorkloadShape(param_bytes=param_bytes,
+                         global_batch=global_batch), None
+
+
+def autotune(
+    spec,
+    batch,
+    devices: Optional[Sequence[Any]] = None,
+    *,
+    tx=None,
+    caps: Optional[Mapping[str, Sequence[int]]] = None,
+    axes: Sequence[str] = DEFAULT_AXES,
+    steps: int = 4,
+    repeats: int = 3,
+    warmup_rounds: int = 1,
+    min_rounds: int = 2,
+    measure_top_k: int = 4,
+    exposed_weight: float = 0.25,
+    noise_mult: float = 2.0,
+    exhaustive: bool = False,
+    seq_sharded: Optional[bool] = None,
+    alpha_bytes: Optional[float] = None,
+    max_candidates: int = 64,
+    artifact_path: Optional[str] = None,
+    telemetry=None,
+    measure_fn: Optional[Callable] = None,
+) -> TuneResult:
+    """Search mesh configs for ``spec`` on ``batch``; return the
+    :class:`TuneResult` whose ``best_config()`` is the chosen mesh.
+
+    The ``measure_top_k`` survivors of the comm-volume prune are
+    compiled once each, then measured in INTERLEAVED rounds of
+    ``steps`` profiled steps per candidate (up to ``repeats`` scored
+    rounds, after ``warmup_rounds`` discarded ones — the FIRST
+    capture per candidate is systematically inflated by profiler
+    init, XLA autotuning, and allocator warmup and must not vote) —
+    back-to-back per-candidate timing on a cpu-share rig lands
+    whole windows in slow scheduler epochs and swings 10x; the
+    interleave samples every candidate across the same epochs, and
+    the pooled median cancels them. The round loop early-stops after
+    ``min_rounds`` once the leader's margin over the runner-up
+    exceeds ``noise_mult x`` the noise floor (cross-candidate max of
+    p75-p25 wall spreads). ``exhaustive=True`` disables pruning and
+    the early stop — every legal candidate is measured for all
+    rounds (the ``make bench-tune`` referee mode). ``measure_fn``
+    (same signature as :func:`prepare_candidate`) lets tests pin the
+    decision logic without a backend."""
+    t_start = time.perf_counter()
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    n_devices = len(devices)
+    global_batch = int(batch.x.shape[0])
+
+    shape, cfg = workload_for(spec, batch)
+    if seq_sharded is None:
+        # Sequence sharding needs token-level targets (y carries a
+        # sequence dim); a classifier's scalar labels cannot split
+        # over sp.
+        seq_sharded = getattr(batch.y, "ndim", 1) >= 2
+    if caps is None:
+        caps = transformer_caps(cfg, shape.seq_len) if cfg is not None \
+            else {"tp": (1,), "sp": (1,), "ep": (1,), "pp": (1,)}
+    caps = dict(caps)
+    if not seq_sharded:
+        caps["sp"] = (1,)
+
+    # Enumerate the FULL legal space — the cost model is what decides
+    # what gets dropped, never enumeration order.
+    configs = enumerate_candidates(n_devices, caps, global_batch,
+                                   axes=axes)
+    if not configs:
+        raise ValueError(
+            f"no legal mesh for {n_devices} devices / batch "
+            f"{global_batch} under caps {caps}"
+        )
+    if alpha_bytes is None:
+        alpha_bytes = alpha_bytes_for_backend()
+    candidates = [
+        Candidate(axes=c.resolve(n_devices),
+                  predicted=predict_comm_bytes(c, shape, n_devices,
+                                               alpha_bytes=alpha_bytes))
+        for c in configs
+    ]
+    # Predicted order, cheapest comm first; ties keep enumeration
+    # order (the sort is stable), so the whole pass is deterministic.
+    candidates.sort(key=lambda c: c.predicted_cost)
+    candidates_dropped = 0
+    if len(candidates) > max_candidates:
+        # Combinatorial-explosion guard, applied AFTER the cost
+        # ranking so what falls off is the model's worst tail — and
+        # loudly, not silently (the dropped count rides the artifact).
+        candidates_dropped = len(candidates) - max_candidates
+        _LOG.warning(
+            f"[sparktorch_tpu:tune] {candidates_dropped} worst-"
+            f"predicted candidates dropped past the "
+            f"max_candidates={max_candidates} cap"
+        )
+        candidates = candidates[:max_candidates]
+
+    to_measure = candidates if exhaustive else candidates[:measure_top_k]
+    measure_ids = {id(c) for c in to_measure}
+    for rank, c in enumerate(candidates):
+        if id(c) in measure_ids:
+            continue
+        c.status = STATUS_PRUNED
+        c.reason = (
+            f"comm_model: rank {rank} of {len(candidates)} "
+            f"({c.predicted_cost / 1e6:.2f}MB-eq/step predicted vs "
+            f"{candidates[0].predicted_cost / 1e6:.2f}MB-eq best)"
+        )
+
+    prepare = measure_fn or prepare_candidate
+    # Phase A: compile every survivor (outside any capture). A layout
+    # the partitioner rejects becomes a failed candidate, never a
+    # failed search.
+    runners: List[Tuple[Candidate, Callable]] = []
+    for cand in to_measure:
+        try:
+            runner = prepare(
+                spec, cand.mesh_config(), batch, devices, tx=tx,
+                seq_sharded=seq_sharded, telemetry=telemetry,
+            )
+        except Exception as e:  # one bad layout must not kill the search
+            cand.status = STATUS_FAILED
+            cand.reason = f"{type(e).__name__}: {e}"
+            _LOG.warning(f"[sparktorch_tpu:tune] candidate {cand.label} "
+                         f"failed to prepare: {cand.reason}")
+            continue
+        runners.append((cand, runner))
+
+    # Phase B: interleaved measurement rounds. Every live candidate
+    # runs `steps` captured steps per round; scores re-aggregate over
+    # the pooled walls after each round.
+    rounds: Dict[int, List[Dict[str, Any]]] = {id(c): [] for c, _ in runners}
+    noise_floor = 0.0
+    early_stopped = False
+    rounds_run = 0
+    executed_steps = 0  # EVERY profiled step run, warmup included
+    for raw_rnd in range(warmup_rounds + repeats):
+        warming = raw_rnd < warmup_rounds
+        rnd = raw_rnd - warmup_rounds
+        live = [(c, r) for c, r in runners if c.status != STATUS_FAILED]
+        if not live:
+            break
+        for cand, runner in live:
+            try:
+                executed_steps += steps
+                record = runner(steps)
+                if warming:
+                    continue  # warmup capture: executed, never scored
+                rounds[id(cand)].append(record)
+            except Exception as e:
+                cand.status = STATUS_FAILED
+                cand.reason = f"{type(e).__name__}: {e}"
+                cand.score = None
+                cand.measured = None
+                _LOG.warning(f"[sparktorch_tpu:tune] candidate "
+                             f"{cand.label} failed mid-measure: "
+                             f"{cand.reason}")
+                continue
+            score, record = _aggregate_rounds(
+                rounds[id(cand)], getattr(runner, "compile_s", 0.0),
+                exposed_weight,
+            )
+            cand.status = STATUS_MEASURED
+            cand.score = float(score)
+            cand.measured = record
+        if warming:
+            continue
+        rounds_run = rnd + 1
+        measured = [c for c, _ in runners if c.status == STATUS_MEASURED]
+        if not measured:
+            continue
+        noise_floor = max((float(c.measured.get("spread_s", 0.0))
+                           for c in measured), default=0.0)
+        ranked = sorted(measured, key=lambda c: c.score)
+        _LOG.info(
+            f"[sparktorch_tpu:tune] round {rnd + 1}/{repeats}: "
+            + ", ".join(
+                f"{c.label} {c.measured['step_wall_s'] * 1e3:.2f}ms"
+                for c in ranked)
+            + f" (noise floor {noise_floor * 1e3:.2f}ms)"
+        )
+        if exhaustive or rnd + 1 >= repeats or rnd + 1 < min_rounds \
+                or len(ranked) < 2:
+            continue
+        margin = noise_mult * noise_floor
+        if ranked[1].score - ranked[0].score > margin:
+            early_stopped = True
+            _LOG.info(
+                f"[sparktorch_tpu:tune] early stop after round "
+                f"{rnd + 1}: {ranked[0].label} leads "
+                f"{ranked[1].label} by "
+                f"{(ranked[1].score - ranked[0].score) * 1e3:.2f}ms "
+                f"> noise margin {margin * 1e3:.2f}ms"
+            )
+            break
+    measured = [c for c, _ in runners if c.status == STATUS_MEASURED]
+    if not measured:
+        raise RuntimeError(
+            "auto-tune measured no candidate successfully: "
+            + "; ".join(f"{c.label}: {c.reason}" for c in to_measure)
+        )
+
+    best = min(measured, key=lambda c: c.score)
+    result = TuneResult(
+        n_devices=n_devices,
+        global_batch=global_batch,
+        best=dict(best.axes),
+        candidates=candidates,
+        noise_floor_s=noise_floor,
+        early_stopped=early_stopped,
+        steps_per_candidate=steps,
+        rounds_run=rounds_run,
+        warmup_rounds=warmup_rounds,
+        executed_steps_total=executed_steps,
+        candidates_dropped=candidates_dropped,
+        wall_s=time.perf_counter() - t_start,
+        exposed_weight=exposed_weight,
+        caps={k: list(v) for k, v in caps.items()},
+        run_id=getattr(telemetry, "run_id", None),
+    )
+    result.publish(telemetry)
+    if artifact_path:
+        result.save(artifact_path)
+    _LOG.info(
+        f"[sparktorch_tpu:tune] chose {result.best_label} from "
+        f"{len(candidates)} candidates "
+        f"({len(result.pruned())} pruned without execution, "
+        f"{len(measured)} measured, early_stop={early_stopped}) "
+        f"in {result.wall_s:.1f}s"
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _cli_spec(model: str, seq: int):
+    from sparktorch_tpu.models import (
+        MnistMLP,
+        SequenceClassifier,
+        bert_base,
+        tiny_transformer,
+    )
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    if model == "tiny":
+        module = SequenceClassifier(tiny_transformer(max_len=seq))
+    elif model == "bert":
+        module = bert_base(max_len=seq)
+    elif model == "mlp":
+        module = MnistMLP()
+    else:
+        raise SystemExit(f"unknown --model {model!r} (tiny|bert|mlp)")
+    return ModelSpec(module=module, loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3})
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    import numpy as np
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sparktorch_tpu.parallel.tune",
+        description="Trace-guided mesh auto-tuner: enumerate legal "
+                    "mesh configs, prune by analytic comm volume, "
+                    "measure survivors under the XLA profiler, emit "
+                    "the winner + full ranking as tune_result.json.",
+    )
+    parser.add_argument("--model", default="tiny",
+                        help="tiny | bert | mlp (synthetic workload)")
+    parser.add_argument("--batch", type=int, default=32,
+                        help="global batch size")
+    parser.add_argument("--seq", type=int, default=16,
+                        help="sequence length (transformer models)")
+    parser.add_argument("--steps", type=int, default=4,
+                        help="profiled steps per candidate per round")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="interleaved measurement rounds")
+    parser.add_argument("--top-k", type=int, default=4,
+                        help="candidates measured after the prune")
+    parser.add_argument("--exhaustive", action="store_true",
+                        help="measure every legal candidate (no prune, "
+                             "no early stop)")
+    parser.add_argument("--out", default="tune_result.json",
+                        help="artifact path")
+    args = parser.parse_args(argv)
+
+    spec = _cli_spec(args.model, args.seq)
+    from sparktorch_tpu.utils.data import DataBatch
+
+    rng = np.random.default_rng(0)
+    if args.model == "mlp":
+        x = rng.normal(size=(args.batch, 784)).astype(np.float32)
+        y = rng.integers(0, 10, (args.batch,)).astype(np.int32)
+    else:
+        x = rng.integers(0, 256, (args.batch, args.seq)).astype(np.int32)
+        y = rng.integers(0, 2, (args.batch,)).astype(np.int32)
+    batch = DataBatch(x=x, y=y, w=np.ones((args.batch,), np.float32))
+
+    result = autotune(
+        spec, batch, steps=args.steps, repeats=args.repeats,
+        measure_top_k=args.top_k, exhaustive=args.exhaustive,
+        artifact_path=args.out,
+    )
+    doc = result.to_dict()
+    print(json.dumps({
+        "best": doc["best_label"],
+        "mesh": doc["best"],
+        "n_candidates": doc["n_candidates"],
+        "n_pruned": doc["n_pruned"],
+        "n_measured": doc["n_measured"],
+        "early_stopped": doc["early_stopped"],
+        "noise_floor_s": round(doc["noise_floor_s"], 6),
+        "wall_s": round(doc["wall_s"], 2),
+        "artifact": args.out,
+        "ranking": doc["ranking"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -139,14 +139,37 @@ def make_sharded_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
     tx: optax.GradientTransformation,
-    mesh: Mesh,
-    state_shardings: TrainState,
+    mesh,
+    state_shardings: Optional[TrainState] = None,
     seq_sharded: bool = False,
     profile_dir: Optional[str] = None,
     telemetry=None,
+    spec=None,
+    sample_batch: Optional[DataBatch] = None,
+    rng: Optional[jax.Array] = None,
+    tune_kwargs: Optional[dict] = None,
 ) -> Callable[[TrainState, DataBatch], Tuple[TrainState, StepMetrics]]:
     """One GSPMD train step: global weighted-mean loss and grads; XLA
     derives every collective from the shardings.
+
+    ``mesh`` is a concrete :class:`jax.sharding.Mesh` — or the string
+    ``"auto"``: the trace-guided auto-tuner
+    (:func:`sparktorch_tpu.parallel.tune.autotune`) searches the legal
+    mesh space for ``spec`` on ``sample_batch`` (both required in auto
+    mode; ``tune_kwargs`` forwards search knobs like ``measure_top_k``
+    or ``artifact_path``) and the winner becomes the mesh. The auto
+    path also initializes the train state INTO the winning layout, so
+    the returned ``run`` exposes ``run.state`` (the initial
+    :class:`TrainState`), ``run.shardings``, and ``run.tune_result``
+    beside the usual ``run.mesh`` — callers start the loop from
+    ``run.state`` instead of calling :func:`create_sharded_state`
+    themselves (the mesh was not known until now). Known cost: the
+    winner's GSPMD program compiles once inside the tuner's
+    measurement and once more for this fresh step closure (jit cannot
+    dedupe across closures) — amortized over a training run, and the
+    per-(workload, rig) tune-result cache filed in ROADMAP item 4's
+    follow-ups is the path to skipping the search (and this recompile)
+    entirely on re-runs.
 
     Telemetry/tracing (same contract as the sync/pp trainers'
     ``profile_dir``): every call of the returned ``run`` carries a
@@ -160,6 +183,37 @@ def make_sharded_train_step(
     metrics, and ``finish()`` returns the :class:`TraceAnalysis`
     (None when nothing was captured).
     """
+    tune_result = None
+    auto_state: Optional[TrainState] = None
+    if isinstance(mesh, str):
+        if mesh != "auto":
+            raise ValueError(f"mesh must be a Mesh or 'auto', got {mesh!r}")
+        if spec is None or sample_batch is None:
+            raise ValueError(
+                "mesh='auto' needs spec= and sample_batch= (the tuner "
+                "measures candidate meshes on a representative batch)"
+            )
+        from sparktorch_tpu.parallel.mesh import build_mesh
+        from sparktorch_tpu.parallel.tune import autotune
+
+        # The tuner, the winning mesh, and the state layout must all
+        # see the SAME device set — a tune_kwargs={'devices': ...}
+        # subset would otherwise pick a config whose axis product no
+        # longer matches jax.devices().
+        tune_kwargs = dict(tune_kwargs or {})
+        devices = tune_kwargs.pop("devices", None) or jax.devices()
+        tune_result = autotune(
+            spec, sample_batch, devices, tx=tx, seq_sharded=seq_sharded,
+            telemetry=telemetry, **tune_kwargs,
+        )
+        mesh = build_mesh(tune_result.best_config(), devices)
+        auto_state, state_shardings = create_sharded_state(
+            spec, mesh,
+            rng if rng is not None else jax.random.key(0),
+            sample_x=sample_batch.x[:1], tx=tx,
+        )
+    if state_shardings is None:
+        raise ValueError("state_shardings is required unless mesh='auto'")
 
     pass_w = _accepts_example_w(apply_fn)
 
@@ -254,6 +308,11 @@ def make_sharded_train_step(
     run.jitted = jitted
     run.mesh = mesh
     run.finish = finish
+    # Auto-tune extras (None unless mesh="auto"): the initial state in
+    # the winning layout, its shardings, and the search record.
+    run.state = auto_state
+    run.shardings = state_shardings
+    run.tune_result = tune_result
     return run
 
 

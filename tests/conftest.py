@@ -44,6 +44,15 @@ if os.environ.get("SPARKTORCH_TPU_TEST_FASTCOMPILE"):
 # recompilation it saves. CheckpointManager additionally disarms a
 # runtime-enabled cache after any orbax restore (utils/checkpoint.py)
 # for non-test runs that opt in.
+# Full-suite trial, 2026-08-03 (the ROADMAP recheck's next step): RED.
+# `SPARKTORCH_TPU_TEST_CACHE=<dir> make test-fast` segfaults
+# deterministically ~20s in, inside tests/test_checkpoint.py
+# (test_resume_exactness on one run, test_streaming_trainer_
+# checkpoint_resume from a COLD cache dir on another) — the crash the
+# recheck's two shard_map/dp-mesh repro shapes missed lives on the
+# checkpoint-restore path, and a cold cache reproduces it (same-
+# session entries, not stale ones). The default therefore STAYS off;
+# do not flip it until a full `make test-fast` survives twice.
 # SPARKTORCH_TPU_TEST_CACHE=<dir> opts a session into a cache dir (at
 # your own risk, e.g. on a TPU backend where the bug doesn't bite).
 _CACHE_DIR = os.environ.get("SPARKTORCH_TPU_TEST_CACHE")

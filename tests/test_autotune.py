@@ -1,0 +1,479 @@
+"""Trace-guided mesh auto-tuner: deterministic candidate enumeration
+and pruning on synthetic shapes, comm cost-model monotonicity, scoring
+from the golden xprof fixture (no backend), artifact round-trip, the
+decision loop against an injected measurer, and ``mesh="auto"``
+end-to-end on the 8-device CPU rig.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sparktorch_tpu.parallel.mesh import MeshConfig
+from sparktorch_tpu.parallel.tune import (
+    Candidate,
+    TuneResult,
+    WorkloadShape,
+    autotune,
+    enumerate_candidates,
+    mesh_label,
+    predict_comm_bytes,
+    score_analysis,
+    transformer_caps,
+    transformer_workload,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "xprof")
+SYNTHETIC = os.path.join(FIXTURES, "synthetic_overlap.trace.json.gz")
+
+
+# ---------------------------------------------------------------------------
+# Enumeration (backend-free)
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_candidates_deterministic_and_legal():
+    """8 devices, tp capped by 2 heads, sp by a 4-token sequence, no
+    experts: the exact legal set, in the exact deterministic order
+    (ascending (fsdp, tp, sp, ep, pp) tuples — pure dp first)."""
+    caps = {"fsdp": (64,), "tp": (2, 128, 256), "sp": (4,), "ep": (1,),
+            "pp": (2,)}
+    got = [c.resolve(8) for c in enumerate_candidates(8, caps, 32)]
+    labels = [mesh_label(s) for s in got]
+    assert labels == [
+        "dp8", "dp4xsp2", "dp2xsp4",
+        "dp4xtp2", "dp2xtp2xsp2", "tp2xsp4",
+        "dp4xfsdp2", "dp2xfsdp2xsp2", "fsdp2xsp4",
+        "dp2xfsdp2xtp2", "fsdp2xtp2xsp2",
+        "dp2xfsdp4", "fsdp4xsp2", "fsdp4xtp2", "fsdp8",
+    ]
+    for sizes in got:
+        # Every candidate fills the device world exactly.
+        prod = 1
+        for v in sizes.values():
+            prod *= v
+        assert prod == 8
+        # And respects its caps: tp | 2, sp | 4, ep == 1.
+        assert 2 % sizes["tp"] == 0
+        assert 4 % sizes["sp"] == 0
+        assert sizes["ep"] == 1
+        # Batch axes divide the global batch.
+        assert 32 % (sizes["dp"] * sizes["fsdp"]) == 0
+    # Same inputs -> same list (determinism is what goldens pin).
+    again = [c.resolve(8) for c in enumerate_candidates(8, caps, 32)]
+    assert again == got
+
+
+def test_enumerate_candidates_batch_and_expert_caps():
+    # A global batch of 4 forbids dp*fsdp == 8.
+    caps = {"fsdp": (64,), "tp": (1,), "sp": (1,), "ep": (1,), "pp": (1,)}
+    labels = [mesh_label(c.resolve(8))
+              for c in enumerate_candidates(8, caps, 4)]
+    assert labels == []  # dp*fsdp must be 8, but 4 % 8 != 0
+    # 4 experts open ep in {1, 2, 4}; ep=8 stays illegal.
+    caps = {"fsdp": (1,), "tp": (1,), "sp": (1,), "ep": (4,), "pp": (1,)}
+    labels = [mesh_label(c.resolve(8))
+              for c in enumerate_candidates(8, caps, 32)]
+    assert labels == ["dp8", "dp4xep2", "dp2xep4"]
+
+
+def test_transformer_caps_follow_model_dims():
+    from sparktorch_tpu.models import tiny_transformer
+
+    cfg = tiny_transformer(max_len=16)  # heads=4, d_ff=128, vocab=256
+    caps = transformer_caps(cfg, seq_len=8)
+    assert caps["tp"] == (4, 128, 256)
+    assert caps["sp"] == (8,)
+    assert caps["ep"] == (1,)          # dense model: ep locked to 1
+    moe = tiny_transformer(n_experts=4)
+    assert transformer_caps(moe)["ep"] == (4,)
+
+
+# ---------------------------------------------------------------------------
+# Cost model (backend-free)
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_monotone_in_replicated_bytes():
+    """More replicated gradient bytes -> strictly higher predicted
+    comm, for every config that reduces gradients (dp or fsdp > 1)."""
+    small = WorkloadShape(param_bytes=1e6, tp_param_bytes=1e6,
+                          global_batch=32, seq_len=16, d_model=64,
+                          n_layers=2)
+    big = WorkloadShape(param_bytes=2e6, tp_param_bytes=2e6,
+                        global_batch=32, seq_len=16, d_model=64,
+                        n_layers=2)
+    for cfg in (MeshConfig(), MeshConfig(fsdp=2), MeshConfig(tp=2),
+                MeshConfig(fsdp=2, tp=2)):
+        lo = predict_comm_bytes(cfg, small, 8)
+        hi = predict_comm_bytes(cfg, big, 8)
+        assert hi["total_bytes"] > lo["total_bytes"], cfg
+        assert hi["total_cost"] > lo["total_cost"], cfg
+
+
+def test_cost_model_terms_and_alpha():
+    shape = WorkloadShape(param_bytes=8e6, tp_param_bytes=8e6,
+                          global_batch=64, seq_len=32, d_model=128,
+                          n_layers=4)
+    pure_dp = predict_comm_bytes(MeshConfig(), shape, 8)
+    # Pure dp: one bucketed grad all-reduce, nothing else.
+    assert pure_dp["collective_ops"] == 1
+    assert pure_dp["tp_all_reduce"] == 0 and pure_dp["sp_ppermute"] == 0
+    # Ring all-reduce of the full replica: 2 * (7/8) * bytes per dev.
+    assert pure_dp["dp_all_reduce"] == pytest.approx(
+        8 * 2 * (7 / 8) * 8e6)
+    tp = predict_comm_bytes(MeshConfig(tp=2), shape, 8)
+    # tp shards the grads (smaller dp term) but pays per-layer
+    # activation all-reduces (2 per layer) in ops and bytes.
+    assert tp["dp_all_reduce"] < pure_dp["dp_all_reduce"]
+    assert tp["tp_all_reduce"] > 0
+    assert tp["collective_ops"] == 1 + 2 * 4
+    # The alpha term orders equal-byte configs by launch count.
+    a0 = predict_comm_bytes(MeshConfig(tp=2), shape, 8, alpha_bytes=0)
+    a1 = predict_comm_bytes(MeshConfig(tp=2), shape, 8,
+                            alpha_bytes=1 << 20)
+    assert a1["total_cost"] == pytest.approx(
+        a0["total_cost"] + (1 << 20) * a0["collective_ops"])
+
+
+# ---------------------------------------------------------------------------
+# Scoring from the golden fixture (no backend)
+# ---------------------------------------------------------------------------
+
+
+def test_score_from_golden_fixture_exact():
+    """The synthetic_overlap fixture has exact known attribution
+    (walls 1000us/800us, comm 500/400us, overlap 200/0us) — so the
+    scoring hook's numbers are closed-form."""
+    from sparktorch_tpu.obs.xprof import analyze_trace
+
+    a = analyze_trace(SYNTHETIC)
+    us = 1e-6
+    stats = a.step_wall_stats()
+    assert stats["n"] == 2
+    assert stats["median_s"] == pytest.approx(900 * us)
+    assert stats["min_s"] == pytest.approx(800 * us)
+    assert stats["max_s"] == pytest.approx(1000 * us)
+    # p75 - p25 of [800, 1000]us interpolates to 950 - 850.
+    assert stats["spread_s"] == pytest.approx(100 * us)
+    # Exposed comm: (500-200) + (400-0) = 700us over 1800us of window.
+    assert a.exposed_comm_s == pytest.approx(700 * us)
+    assert a.exposed_comm_fraction == pytest.approx(700 / 1800)
+    score, measured = score_analysis(a, exposed_weight=0.25)
+    assert score == pytest.approx(900 * us * (1 + 0.25 * 700 / 1800))
+    assert measured["step_wall_s"] == pytest.approx(900 * us)
+    assert measured["exposed_comm_fraction"] == pytest.approx(700 / 1800)
+    assert measured["n_collective_events"] == 5
+    # Zero weight: the score IS the median wall.
+    score0, _ = score_analysis(a, exposed_weight=0.0)
+    assert score0 == pytest.approx(900 * us)
+
+
+# ---------------------------------------------------------------------------
+# Decision loop with an injected measurer (no backend)
+# ---------------------------------------------------------------------------
+
+
+def _fake_spec_and_batch():
+    """A ModelSpec whose module carries a TransformerConfig, plus a
+    batch — none of it is ever executed (measure_fn is injected)."""
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    module = SequenceClassifier(tiny_transformer(max_len=16))
+    spec = ModelSpec(module=module, loss="cross_entropy")
+    batch = DataBatch(
+        x=np.zeros((32, 16), np.int32),
+        y=np.zeros((32,), np.int32),
+        w=np.ones((32,), np.float32),
+    )
+    return spec, batch
+
+
+def _fake_measure(walls):
+    """measure_fn (prepare_candidate contract): scripted
+    ``(wall, half_spread)`` per mesh label — each round's runner
+    returns walls ``[w-s, w, w+s]`` so the pooled median is ``w`` and
+    the spread scales with ``s``."""
+
+    def prepare(spec, config, batch, devices, tx=None,
+                seq_sharded=False, telemetry=None):
+        label = mesh_label(config.resolve(len(devices)))
+        wall, s = walls[label]
+
+        def runner(steps):
+            base = [wall - s, wall, wall + s]
+            return {"walls": (base * steps)[:max(steps, 1)],
+                    "comm_fraction": 0.3, "overlap_fraction": 0.5,
+                    "exposed_comm_fraction": 0.1,
+                    "n_collective_events": steps, "counts": {},
+                    "loss": 0.0}
+
+        runner.compile_s = 1.0
+        return runner
+
+    return prepare
+
+
+def test_autotune_prunes_measures_and_ranks():
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))  # the fake measurer only len()s these
+    # Half-spreads of 2ms keep the noise floor ABOVE the 1ms wall
+    # gaps, so the round loop never early-stops.
+    walls = {label: (0.010 + 0.001 * i, 0.002)
+             for i, label in enumerate([
+                 "dp8", "fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+                 "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"])}
+    walls["fsdp8"] = (0.008, 0.002)  # scripted winner, rank 2 by cost
+    result = autotune(spec, batch, devices, steps=3, repeats=3,
+                      measure_top_k=4, noise_mult=2.0,
+                      measure_fn=_fake_measure(walls),
+                      alpha_bytes=1 << 20)
+    assert result.best_label == "fsdp8"
+    assert not result.early_stopped and result.rounds_run == 3
+    statuses = {c.label: c.status for c in result.candidates}
+    assert sum(s == "measured" for s in statuses.values()) == 4
+    assert sum(s == "pruned" for s in statuses.values()) == 5
+    # Pruned candidates carry the model's reasoning, never a
+    # measurement.
+    for c in result.candidates:
+        if c.status == "pruned":
+            assert c.measured is None and "comm_model" in c.reason
+    # The ranking is measured-only, best first.
+    ranked = result.ranking()
+    assert ranked[0].label == "fsdp8"
+    assert [c.label for c in ranked] == sorted(
+        (c.label for c in result.candidates if c.status == "measured"),
+        key=lambda l: walls[l][0],
+    )
+    # All rounds ran for every measured candidate.
+    assert result.measured_steps_total() == 4 * 3 * 3
+
+
+def test_autotune_early_stops_on_noise_floor():
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+    # dp8 at 10ms vs everyone at 30ms, tiny spread: after min_rounds
+    # the 20ms lead dwarfs the noise floor -> the round loop stops.
+    walls = {"dp8": (0.010, 0.0002)}
+    for label in ("fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+                  "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"):
+        walls[label] = (0.030, 0.0002)
+    result = autotune(spec, batch, devices, steps=2, repeats=4,
+                      min_rounds=2, measure_top_k=6, noise_mult=2.0,
+                      measure_fn=_fake_measure(walls),
+                      alpha_bytes=1 << 20)
+    assert result.early_stopped
+    assert result.best_label == "dp8"
+    assert result.rounds_run == 2       # stopped right after min_rounds
+    assert sum(c.status == "measured" for c in result.candidates) == 6
+    assert result.measured_steps_total() == 6 * 2 * 2
+    # A noisy floor suppresses the early stop: same walls, but spreads
+    # wider than the lead keep the tuner measuring all rounds.
+    noisy = {k: (w, 0.05) for k, (w, _s) in walls.items()}
+    result2 = autotune(spec, batch, devices, steps=2, repeats=4,
+                       min_rounds=2, measure_top_k=6, noise_mult=2.0,
+                       measure_fn=_fake_measure(noisy),
+                       alpha_bytes=1 << 20)
+    assert not result2.early_stopped
+    assert result2.rounds_run == 4
+
+
+def test_autotune_survives_failed_candidates():
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+
+    calls = []
+
+    def prepare(spec, config, batch, devices, **kw):
+        label = mesh_label(config.resolve(len(devices)))
+        calls.append(label)
+        if len(calls) == 1:
+            raise RuntimeError("compile exploded")
+
+        def runner(steps):
+            return {"walls": [0.01] * steps, "comm_fraction": 0.1,
+                    "overlap_fraction": 0.0,
+                    "exposed_comm_fraction": 0.0,
+                    "n_collective_events": 0, "counts": {}}
+
+        return runner
+
+    result = autotune(spec, batch, devices, steps=2, measure_top_k=2,
+                      measure_fn=prepare, alpha_bytes=1 << 20)
+    failed = [c for c in result.candidates if c.status == "failed"]
+    assert len(failed) == 1 and "compile exploded" in failed[0].reason
+    assert result.best_label == calls[1]
+
+
+def test_autotune_survives_mid_measure_failure():
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+
+    def prepare(spec, config, batch, devices, **kw):
+        label = mesh_label(config.resolve(len(devices)))
+        state = {"rounds": 0}
+
+        def runner(steps):
+            state["rounds"] += 1
+            if label == "dp8" and state["rounds"] == 2:
+                raise RuntimeError("device wedged")
+            return {"walls": [0.02 if label == "dp8" else 0.03] * steps,
+                    "comm_fraction": 0.1, "overlap_fraction": 0.0,
+                    "exposed_comm_fraction": 0.0,
+                    "n_collective_events": 0, "counts": {}}
+
+        return runner
+
+    result = autotune(spec, batch, devices, steps=2, repeats=3,
+                      measure_top_k=2, noise_mult=2.0,
+                      measure_fn=prepare, alpha_bytes=1 << 20)
+    # dp8 died in round 2 -> failed, dropped from later rounds; the
+    # survivor wins on its own pooled rounds.
+    by_label = {c.label: c for c in result.candidates}
+    assert by_label["dp8"].status == "failed"
+    assert "device wedged" in by_label["dp8"].reason
+    assert result.best_label != "dp8"
+
+
+# ---------------------------------------------------------------------------
+# Artifact + telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_tune_result_artifact_roundtrip(tmp_path):
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+    walls = {label: (0.010 + 0.001 * i, 0.001) for i, label in enumerate([
+        "dp8", "fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+        "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"])}
+    path = str(tmp_path / "tune_result.json")
+    result = autotune(spec, batch, devices, steps=2, measure_top_k=3,
+                      measure_fn=_fake_measure(walls),
+                      alpha_bytes=1 << 20, artifact_path=path)
+    loaded = TuneResult.load(path)
+    assert loaded.to_dict() == result.to_dict()
+    assert loaded.best_config() == result.best_config()
+    # The artifact names its kind and carries the full prune log.
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["kind"] == "tune"
+    assert doc["n_pruned"] == 6 and len(doc["candidates"]) == 9
+    # A non-tune JSON is refused, loudly.
+    other = tmp_path / "not_tune.json"
+    other.write_text(json.dumps({"kind": "gang"}))
+    with pytest.raises(ValueError):
+        TuneResult.load(str(other))
+
+
+def test_tune_publish_puts_xprof_tune_on_the_bus(tmp_path):
+    from sparktorch_tpu.obs import Telemetry
+
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+    walls = {label: (0.010, 0.001) for label in [
+        "dp8", "fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+        "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"]}
+    tele = Telemetry(run_id="tune_pub")
+    result = autotune(spec, batch, devices, steps=3, measure_top_k=2,
+                      measure_fn=_fake_measure(walls),
+                      alpha_bytes=1 << 20, telemetry=tele)
+    snap = tele.snapshot()
+    assert snap["counters"]["xprof.tune_runs_total"] == 1
+    assert snap["counters"][
+        "xprof.tune_candidates_total{outcome=measured}"] == 2
+    assert snap["counters"][
+        "xprof.tune_candidates_total{outcome=pruned}"] == 7
+    assert snap["gauges"]["xprof.tune_best_step_wall_s"] == \
+        pytest.approx(0.010)
+    section = tele.get_section("xprof_tune")
+    assert section["best_label"] == result.best_label
+    assert len(section["candidates"]) == 9
+    # The timeline renders the section from a dump, and the artifact
+    # from disk — same report.
+    from sparktorch_tpu.obs.timeline import render_tune_report
+
+    report = render_tune_report(section)
+    assert result.best_label in report and "<- chosen" in report
+    assert "pruned" in report
+
+
+def test_timeline_tune_cli(tmp_path, capsys):
+    from sparktorch_tpu.obs.timeline import main as timeline_main
+
+    spec, batch = _fake_spec_and_batch()
+    walls = {label: (0.010, 0.001) for label in [
+        "dp8", "fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+        "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"]}
+    path = str(tmp_path / "tune_result.json")
+    autotune(spec, batch, list(range(8)), steps=2, measure_top_k=2,
+             measure_fn=_fake_measure(walls), alpha_bytes=1 << 20,
+             artifact_path=path)
+    assert timeline_main([path, "--tune"]) == 0
+    out = capsys.readouterr().out
+    assert "mesh auto-tune" in out and "chosen" in out
+    # Not a tune artifact -> exit 1 with a clear error.
+    bad = tmp_path / "trace.json"
+    bad.write_text(json.dumps({"traceEvents": []}))
+    assert timeline_main([str(bad), "--tune"]) == 1
+    # --gang + --tune is a usage error.
+    assert timeline_main([path, "--gang", "--tune"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# mesh="auto" end-to-end (8-device CPU rig)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_auto_end_to_end(tmp_path):
+    """The usable fast path: make_sharded_train_step(mesh='auto')
+    searches the mesh space for real (1 measured candidate to keep the
+    tier-1 budget sane), initializes state into the winning layout,
+    and trains."""
+    import jax
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.train.sharded import make_sharded_train_step, shard_batch
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    rng = np.random.default_rng(0)
+    bsz, seq = 16, 8
+    batch = DataBatch(
+        x=rng.integers(0, 256, (bsz, seq)).astype(np.int32),
+        y=rng.integers(0, 2, (bsz,)).astype(np.int32),
+        w=np.ones((bsz,), np.float32),
+    )
+    module = SequenceClassifier(tiny_transformer(max_len=seq, n_layers=1))
+    spec = ModelSpec(module=module, loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3})
+    artifact = str(tmp_path / "tune_result.json")
+    step = make_sharded_train_step(
+        module.apply, spec.loss_fn(), spec.make_optimizer(),
+        mesh="auto", spec=spec, sample_batch=batch,
+        tune_kwargs={"measure_top_k": 1, "steps": 2, "repeats": 2,
+                     "artifact_path": artifact},
+    )
+    # The auto path hands back the search and the initialized state.
+    assert step.tune_result is not None and step.state is not None
+    assert step.tune_result.best_label == "dp8"  # cheapest predicted
+    assert os.path.exists(artifact)
+    chosen = step.tune_result.best_config().resolve(
+        len(jax.devices()))
+    assert dict(step.mesh.shape) == chosen
+    # And it trains: two steps, finite decreasing-ish loss.
+    sharded = shard_batch(batch, step.mesh)
+    state = step.state
+    state, m0 = step(state, sharded)
+    state, m1 = step(state, sharded)
+    assert np.isfinite(float(m0.loss)) and np.isfinite(float(m1.loss))
+    # Without spec/sample_batch, auto mode refuses loudly.
+    with pytest.raises(ValueError, match="sample_batch"):
+        make_sharded_train_step(module.apply, spec.loss_fn(),
+                                spec.make_optimizer(), mesh="auto")
+    with pytest.raises(ValueError, match="Mesh or 'auto'"):
+        make_sharded_train_step(module.apply, spec.loss_fn(),
+                                spec.make_optimizer(), mesh="bogus")

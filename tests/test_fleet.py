@@ -397,6 +397,100 @@ def test_shard_add_and_drain_mid_run_exact_records(payload):
         fleet.stop()
 
 
+def test_push_residuals_survive_reshard_exactly():
+    """Error-feedback residuals are keyed by leaf PATH at the
+    ShardedTransport level (PR 6 follow-up): a leaf that migrates to a
+    different shard on drain keeps its accumulated quantization noise,
+    so the error-feedback identity
+
+        sum(applied quantized grads) + residual == sum(raw grads)
+
+    holds EXACTLY across the reshard. With per-shard residual stores
+    (the old layout) the migrated leaf's noise stays orphaned in the
+    old transport and the identity is off by one window's residual."""
+    payload = serialize_torch_obj(
+        Net(), criterion="mse", optimizer="sgd",
+        optimizer_params={"lr": 0.1}, input_shape=(10,),
+    )
+    lr = 0.1
+    rng = np.random.default_rng(7)
+    tele = Telemetry(run_id="fleet_residual_rekey")
+    fleet = ParamServerFleet(payload, n_shards=2, telemetry=tele).start()
+    transport = None
+    try:
+        transport = ShardedTransport(fleet, quant="int8", telemetry=tele)
+        _, init = transport.pull(-1)
+        init_flat = {p: np.array(a) for p, a in wire.flatten_tree(init)}
+        paths = sorted(init_flat)
+
+        def _grads():
+            # pi-scaled values: guaranteed int8-unrepresentable, so
+            # every leaf accrues a real nonzero residual.
+            return wire.unflatten_tree([
+                (p, (np.pi * rng.normal(1.0, 0.3, init_flat[p].shape))
+                 .astype(np.float32))
+                for p in paths
+            ])
+
+        def _wait_applied(n):
+            # applied_updates sums over LIVE shards (a drained shard
+            # takes its count with it), so targets are measured
+            # relative to a fresh baseline after any reshard.
+            deadline = time.monotonic() + 20
+            while fleet.applied_updates < n:
+                assert time.monotonic() < deadline, (
+                    f"fleet applied {fleet.applied_updates} < {n}"
+                )
+                time.sleep(0.01)
+
+        owners1 = sum(bool(v) for v in
+                      transport._ring.assignment(paths).values())
+        g1 = _grads()
+        transport.push(g1)
+        _wait_applied(owners1)
+
+        # Reshard mid-quantized-run: drain shard 0 — every leaf it
+        # owned migrates to the surviving shard (guaranteed >=1 moved,
+        # unlike an add, where md5 arcs decide).
+        moved_before = transport._ring.assignment(paths)
+        fleet.drain_shard("0")
+        migrated = [p for p in paths
+                    if moved_before and p in
+                    set(moved_before.get("0", []))]
+        assert migrated, "shard 0 owned no leaves — reshard untested"
+        # The client learns the new ring on its next pull.
+        transport.pull(-1)
+        assert "0" not in transport._clients
+
+        base = fleet.applied_updates
+        g2 = _grads()
+        transport.push(g2)
+        _wait_applied(base + 1)
+
+        final_flat = {p: np.array(a)
+                      for p, a in wire.flatten_tree(fleet.assemble())}
+        residuals = transport._push_residuals
+        for p in paths:
+            # sgd: params -= lr * q, so sum(q) = (init - final) / lr.
+            applied_sum = (init_flat[p] - final_flat[p]) / lr
+            raw = (np.asarray(dict(wire.flatten_tree(g1))[p], np.float64)
+                   + np.asarray(dict(wire.flatten_tree(g2))[p],
+                                np.float64))
+            resid = np.asarray(residuals.get(p, 0.0), np.float64)
+            np.testing.assert_allclose(
+                applied_sum + resid, raw, atol=5e-5,
+                err_msg=f"EF identity broken at {p} "
+                        f"(migrated={p in migrated})",
+            )
+        # And the reshard genuinely exercised quantization noise.
+        assert any(np.abs(np.asarray(residuals[p])).max() > 1e-6
+                   for p in migrated), "migrated leaves had no residual"
+    finally:
+        if transport is not None:
+            transport.close()
+        fleet.stop()
+
+
 def test_chaos_shard_kill_recovers_within_grace(payload):
     """Seeded shard kill (ft.chaos `fleet.shard` site): the client
     degrades to the remaining ring (counted, not fatal), the fleet

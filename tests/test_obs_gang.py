@@ -537,13 +537,15 @@ def test_comm_drift_gate_fires_and_skips(monkeypatch):
     (fails `make bench-trace`)."""
     from sparktorch_tpu import bench as bench_mod
 
-    monkeypatch.setattr(bench_mod, "_prior_comm_budget", lambda cfg: None)
+    monkeypatch.setattr(bench_mod, "_prior_comm_budget",
+                        lambda cfg, **kw: None)
     rec = bench_mod._check_comm_drift("sharded_trace", 0.5, 0.6)
     assert rec["status"] == "no_prior_record"
 
     prior = {"config": "sharded_trace", "comm_fraction": 0.5,
              "overlap_fraction": 0.6, "ts": "2026-07-01T00:00:00"}
-    monkeypatch.setattr(bench_mod, "_prior_comm_budget", lambda cfg: prior)
+    monkeypatch.setattr(bench_mod, "_prior_comm_budget",
+                        lambda cfg, **kw: prior)
     rec = bench_mod._check_comm_drift("sharded_trace", 0.55, 0.5)
     assert rec["status"] == "checked"
     assert rec["comm_fraction_delta"] == pytest.approx(0.05)
@@ -558,6 +560,66 @@ def test_comm_drift_gate_fires_and_skips(monkeypatch):
     monkeypatch.setenv("SPARKTORCH_TPU_COMM_DRIFT_TOL", "0.5")
     assert bench_mod._check_comm_drift(
         "sharded_trace", 0.8, 0.3)["status"] == "checked"
+
+
+def test_gang_drift_gate_fires_and_skips(monkeypatch):
+    """The armed GANG-level drift gate (PR 5 follow-up): no prior gang
+    record -> clean skip; within tolerance -> checked record with
+    deltas; cross-rank step skew growing past the relative limit or
+    gang comm fraction past the absolute tolerance -> AssertionError
+    (fails `make bench-trace`, which runs the gang_obs config)."""
+    from sparktorch_tpu import bench as bench_mod
+
+    monkeypatch.setattr(bench_mod, "_prior_gang_budget", lambda cfg: None)
+    rec = bench_mod._check_gang_drift("gang_obs", 0.2, 0.5)
+    assert rec["status"] == "no_prior_record"
+
+    prior = {"config": "gang_obs", "gang_comm_fraction": 0.5,
+             "gang_step_skew_s": 0.2, "ts": "2026-07-01T00:00:00"}
+    monkeypatch.setattr(bench_mod, "_prior_gang_budget", lambda cfg: prior)
+    rec = bench_mod._check_gang_drift("gang_obs", 0.25, 0.55)
+    assert rec["status"] == "checked"
+    assert rec["gang_step_skew_delta_s"] == pytest.approx(0.05)
+    assert rec["gang_comm_fraction_delta"] == pytest.approx(0.05)
+    # A straggler: skew grows past prior * 1.5 + 50ms.
+    with pytest.raises(AssertionError, match="step skew"):
+        bench_mod._check_gang_drift("gang_obs", 0.40, 0.5)
+    # Gang comm fraction growing past tolerance fails too.
+    with pytest.raises(AssertionError, match="comm_fraction"):
+        bench_mod._check_gang_drift("gang_obs", 0.2, 0.8)
+    # Both tolerances are operator-tunable via env knobs.
+    monkeypatch.setenv("SPARKTORCH_TPU_GANG_SKEW_TOL", "2.0")
+    monkeypatch.setenv("SPARKTORCH_TPU_COMM_DRIFT_TOL", "0.5")
+    assert bench_mod._check_gang_drift(
+        "gang_obs", 0.40, 0.8)["status"] == "checked"
+    # Microsecond-scale synthetic skews ride inside the 50ms absolute
+    # floor — rounding jitter alone can never trip the gate.
+    monkeypatch.delenv("SPARKTORCH_TPU_GANG_SKEW_TOL", raising=False)
+    prior_tiny = {"config": "gang_obs", "gang_comm_fraction": 0.5,
+                  "gang_step_skew_s": 0.0005}
+    monkeypatch.setattr(bench_mod, "_prior_gang_budget",
+                        lambda cfg: prior_tiny)
+    assert bench_mod._check_gang_drift(
+        "gang_obs", 0.0012, 0.5)["status"] == "checked"
+
+
+def test_prior_gang_budget_scans_round_artifacts(tmp_path):
+    """_prior_gang_budget wants records carrying a MERGED gang budget
+    (gang_comm_fraction) — per-rank comm records don't count."""
+    from sparktorch_tpu import bench as bench_mod
+
+    root = tmp_path
+    (root / "benchmarks").mkdir()
+    (root / "benchmarks" / "log.jsonl").write_text(
+        json.dumps({"config": "gang_obs", "comm_fraction": 0.4}) + "\n"
+        + json.dumps({"config": "gang_obs", "gang_comm_fraction": 0.33,
+                      "gang_step_skew_s": 0.001,
+                      "ts": "2026-08-01T00:00:00"}) + "\n")
+    prior = bench_mod._prior_gang_budget("gang_obs", root=str(root))
+    assert prior is not None and prior["gang_comm_fraction"] == 0.33
+    # A per-rank record alone is not a gang prior.
+    assert bench_mod._prior_gang_budget("sharded_trace",
+                                        root=str(root)) is None
 
 
 def test_prior_comm_budget_scans_round_artifacts(tmp_path):
@@ -591,6 +653,19 @@ def test_prior_comm_budget_scans_round_artifacts(tmp_path):
     }))
     prior = bench_mod._prior_comm_budget("moe_lm", root=str(root))
     assert prior["comm_fraction"] == 0.55
+    # mesh= restricts the scan to SAME-LAYOUT priors: the newest
+    # record under another mesh is skipped in favor of an older
+    # matching one; mesh-less (pre-knob) records always qualify.
+    (root / "benchmarks" / "meshed.jsonl").write_text(
+        json.dumps({"config": "moe_lm", "comm_fraction": 0.10,
+                    "mesh": "fsdp8",
+                    "ts": "2026-08-03T00:00:00"}) + "\n")
+    prior = bench_mod._prior_comm_budget("moe_lm", root=str(root),
+                                         mesh="dp4xtp2")
+    assert prior["comm_fraction"] == 0.55   # fsdp8 record skipped
+    prior = bench_mod._prior_comm_budget("moe_lm", root=str(root),
+                                         mesh="fsdp8")
+    assert prior["comm_fraction"] == 0.10   # matching mesh wins
 
 
 def test_gang_obs_bench_gate_passes():
