@@ -6,7 +6,7 @@ PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
-	bench-ps-fleet bench-tune cluster-up clean lint-obs
+	bench-ps-fleet bench-tune bench-rpc-trace cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -31,6 +31,11 @@ install:
 #   error taxonomy, degradation discipline). Non-scrape urllib use
 #   (e.g. the dill data wire) carries a `lint-obs: ok (<why>)`
 #   annotation.
+# - no minting of RPC span contexts outside obs/: `SpanContext(...)`
+#   construction belongs to obs/rpctrace.py's helpers (root_span /
+#   child_span / SpanContext.child / the from_* parsers), which is
+#   where sampling decisions, SLO forcing, and id entropy stay
+#   audited. Annotated exemptions like the urllib rule.
 lint-obs:
 	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
 		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:' \
@@ -63,6 +68,15 @@ lint-obs:
 		echo "lint-obs: ad-hoc urllib scraping outside obs/ (use"; \
 		echo "obs.collector.scrape_json/scrape_text, or annotate a"; \
 		echo "non-scrape data wire with 'lint-obs: ok (<why>)'):"; \
+		echo "$$hits"; exit 1; \
+	fi; \
+	hits=$$(grep -rn --include='*.py' -E 'SpanContext\(' \
+		sparktorch_tpu/ | grep -v '^sparktorch_tpu/obs/' \
+		| grep -v 'lint-obs: ok'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: span context minted outside obs/ (go through"; \
+		echo "obs.rpctrace tracer helpers — root_span/child_span/"; \
+		echo "SpanContext.child — or annotate 'lint-obs: ok (<why>)'):"; \
 		echo "$$hits"; exit 1; \
 	fi; echo "lint-obs OK"
 
@@ -159,6 +173,17 @@ bench-tune:
 # once. Backend-free — no devices needed.
 bench-gang-obs:
 	$(PYTHON) -m sparktorch_tpu.bench --config gang_obs
+
+# Per-request RPC tracing gate: tracing overhead must stay < 2% at
+# default head sampling on the binary-wire push/pull loop; a traced
+# 4-shard pull must yield one stitched span tree per sampled request
+# whose serve spans reconcile with the wire_latency_s histograms
+# (same population, p50 within tolerance); and a seeded slow shard
+# (ft.chaos slow_shard_s) must be named as the critical path in the
+# collector's stitched output and in `timeline --rpc` — FAILS
+# otherwise. Runs on any backend (JAX_PLATFORMS=cpu works).
+bench-rpc-trace:
+	$(PYTHON) -m sparktorch_tpu.bench --config rpc_trace
 
 # Parameter-server fleet gate: under a sparse-update worker swarm, a
 # 4-shard fleet must beat the single server on aggregate pull

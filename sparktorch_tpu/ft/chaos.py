@@ -76,6 +76,13 @@ class ChaosConfig:
     # monitor must bring the shard back.
     kill_shard_at: Mapping[Any, int] = dataclasses.field(
         default_factory=dict)
+    # shard id -> seconds of injected latency on EVERY request that
+    # shard's HTTP frontend serves while the config is installed — the
+    # straggler-shard fault: the shard stays correct, just slow, which
+    # is exactly what per-request tracing must attribute (the slow
+    # hop named as the critical path, not inferred from aggregates).
+    slow_shard_s: Mapping[Any, float] = dataclasses.field(
+        default_factory=dict)
 
 
 class ChaosInjector:
@@ -158,6 +165,14 @@ class ChaosInjector:
                     return {"truncate": True}
         elif site == "fleet.shard":
             shard = str(ctx.get("shard"))
+            action: Dict[str, Any] = {}
+            delay = next((float(v) for k, v in cfg.slow_shard_s.items()
+                          if str(k) == shard), None)
+            if delay:
+                with self._lock:
+                    self._record(site, shard=shard,
+                                 route=ctx.get("route"), delay_s=delay)
+                action["delay"] = delay
             at = next((int(v) for k, v in cfg.kill_shard_at.items()
                        if str(k) == shard), None)
             if at is not None:
@@ -170,7 +185,8 @@ class ChaosInjector:
                         self._shard_kills_fired.add(shard)
                         self._record(site, shard=shard,
                                      route=ctx.get("route"))
-                        return {"die": True}
+                        action["die"] = True
+            return action or None
         return None
 
 

@@ -338,6 +338,20 @@ class ShardedTransport:
         if self.telemetry is not None:
             self.telemetry.counter(name, labels=labels or {})
 
+    def _tracer(self):
+        """This transport's rpc tracer (the worker bus's), resolved
+        once — re-resolving through the registry's global lock per
+        shard hop would make fan-out threads contend on it. The
+        sharded fan-out owns the REQUEST root: one ``pull``/``push``
+        root span per operation, one ``shard_*`` child per shard hop,
+        with the per-shard BinaryTransports only propagating."""
+        tracer = getattr(self, "_tracer_cached", None)
+        if tracer is None:
+            from sparktorch_tpu.obs.rpctrace import tracer_for
+
+            tracer = self._tracer_cached = tracer_for(self.telemetry)
+        return tracer
+
     # -- fault degradation -------------------------------------------------
 
     def _degrade(self, client: _ShardClient, exc: BaseException,
@@ -370,7 +384,10 @@ class ShardedTransport:
         st = self._own
         t0 = time.perf_counter()
         clients = list(self._clients.values())
-        results = self._fan(self._pull_shard, clients)
+        with self._tracer().root_span("pull", kind="client",
+                                      shards=len(clients)) as root:
+            results = self._fan(
+                lambda c: self._pull_shard(c, root.ctx), clients)
         st["pull_s"] += time.perf_counter() - t0
         st["pulls"] += 1
         fresh = any(r and r.get("fresh") for r in results)
@@ -395,51 +412,70 @@ class ShardedTransport:
         st["pull_fresh"] += 1
         return version, wire.unflatten_tree(list(self._leaves.items()))
 
-    def _pull_shard(self, client: _ShardClient) -> Optional[dict]:
-        try:
-            res = client.transport.pull_delta(lambda: client.have,
-                                              quant=self.pull_quant)
-            epoch = res.get("epoch")
-            if (epoch is not None and client.epoch is not None
-                    and epoch != client.epoch):
-                # The shard's slot was rebuilt (restart, re-add): its
-                # version counter restarted, so our have-version is
-                # meaningless — full resync from -1.
-                client.have = -1
-                self._count("sharded_epoch_resyncs_total",
-                            {"shard": client.sid})
+    def _pull_shard(self, client: _ShardClient,
+                    trace_parent=None) -> Optional[dict]:
+        with self._tracer().child_span("shard_pull", trace_parent,
+                                       kind="client",
+                                       shard=client.sid) as tsp:
+            # tsp.ctx when this hop records; else the ROOT's context
+            # (possibly the shared unsampled one) so the per-shard
+            # transport propagates the root's sampling decision
+            # instead of minting an independent root per shard — a
+            # 99%-unsampled sharded pull must not fill the ring with
+            # shard-level "requests" (or trip the SLO hatch per hop).
+            hop_ctx = tsp.ctx or trace_parent
+            try:
                 res = client.transport.pull_delta(lambda: client.have,
-                                                  quant=self.pull_quant)
+                                                  quant=self.pull_quant,
+                                                  _trace=hop_ctx)
                 epoch = res.get("epoch")
-            if epoch is not None:
-                client.epoch = epoch
-        except (TransportError, wire.WireError, OSError) as e:
-            if not client.synced:
-                # Never synced: there are no cached leaves to freeze,
-                # so "degrading" would hand the worker a PARTIAL tree
-                # (missing this shard's ~1/N of the model) and crash
-                # it inside flax instead. Fail the pull loudly; the
-                # worker (or its supervisor) retries after the
-                # monitor's restart. (A dedicated flag, not have<0:
-                # an epoch resync resets `have` while the cache stays
-                # complete — a flaky resync retry must take the
-                # grace-window path like any other mid-run failure.)
-                raise TransportError(
-                    f"shard {client.sid} unreachable before its first "
-                    f"sync — no cached leaves to degrade to"
-                ) from e
-            self._degrade(client, e, "pull")
-            return None
-        client.first_fail = None
-        if res.get("fresh"):
-            client.have = int(res["version"])
-            client.synced = True
-            with self._own_lock:
-                self._own["delta_leaves"] += len(res["leaves"])
-            # Disjoint key ranges per shard: concurrent merges from
-            # the fan-out threads never write the same path.
-            self._leaves.update(res["leaves"])
-        return res
+                if (epoch is not None and client.epoch is not None
+                        and epoch != client.epoch):
+                    # The shard's slot was rebuilt (restart, re-add):
+                    # its version counter restarted, so our
+                    # have-version is meaningless — full resync from -1.
+                    client.have = -1
+                    self._count("sharded_epoch_resyncs_total",
+                                {"shard": client.sid})
+                    res = client.transport.pull_delta(
+                        lambda: client.have, quant=self.pull_quant,
+                        _trace=hop_ctx)
+                    epoch = res.get("epoch")
+                if epoch is not None:
+                    client.epoch = epoch
+            except (TransportError, wire.WireError, OSError) as e:
+                tsp.set_error(e)
+                if not client.synced:
+                    # Never synced: there are no cached leaves to
+                    # freeze, so "degrading" would hand the worker a
+                    # PARTIAL tree (missing this shard's ~1/N of the
+                    # model) and crash it inside flax instead. Fail the
+                    # pull loudly; the worker (or its supervisor)
+                    # retries after the monitor's restart. (A dedicated
+                    # flag, not have<0: an epoch resync resets `have`
+                    # while the cache stays complete — a flaky resync
+                    # retry must take the grace-window path like any
+                    # other mid-run failure.)
+                    raise TransportError(
+                        f"shard {client.sid} unreachable before its "
+                        f"first sync — no cached leaves to degrade to"
+                    ) from e
+                self._degrade(client, e, "pull")
+                # The hop stays IN the trace, closed with error status
+                # and marked degraded: a grace-window brown-out must be
+                # visible in the request tree, not an absent branch.
+                tsp.annotate(degraded=True)
+                return None
+            client.first_fail = None
+            if res.get("fresh"):
+                client.have = int(res["version"])
+                client.synced = True
+                with self._own_lock:
+                    self._own["delta_leaves"] += len(res["leaves"])
+                # Disjoint key ranges per shard: concurrent merges from
+                # the fan-out threads never write the same path.
+                self._leaves.update(res["leaves"])
+            return res
 
     def push(self, grads) -> None:
         """Split the gradient tree by ring ownership and scatter the
@@ -456,25 +492,37 @@ class ShardedTransport:
         t1 = time.perf_counter()
         st["push_materialize_s"] += t1 - t0
 
-        def _push_one(item) -> None:
+        def _push_one(item, trace_parent=None) -> None:
             sid, paths = item
             if not paths:
                 return
             client = self._clients[sid]
             partial = wire.unflatten_tree([(p, flat[p]) for p in paths])
-            try:
-                client.transport.push(partial)
-                client.first_fail = None
-            except (TransportError, wire.WireError, OSError) as e:
-                # Hogwild tolerates a lost gradient partial the same
-                # way it tolerates staleness; a shard in its grace
-                # window costs updates, not the run.
-                with self._own_lock:
-                    self._own["pushes_skipped"] += 1
-                self._count("sharded_pushes_skipped_total", {"shard": sid})
-                self._degrade(client, e, "push")
+            with self._tracer().child_span("shard_push", trace_parent,
+                                           kind="client",
+                                           shard=sid) as tsp:
+                try:
+                    # Root ctx fallback like _pull_shard: an unsampled
+                    # request must suppress per-shard root minting.
+                    client.transport.push(partial,
+                                          _trace=tsp.ctx or trace_parent)
+                    client.first_fail = None
+                except (TransportError, wire.WireError, OSError) as e:
+                    # Hogwild tolerates a lost gradient partial the
+                    # same way it tolerates staleness; a shard in its
+                    # grace window costs updates, not the run.
+                    tsp.set_error(e)
+                    tsp.annotate(degraded=True)
+                    with self._own_lock:
+                        self._own["pushes_skipped"] += 1
+                    self._count("sharded_pushes_skipped_total",
+                                {"shard": sid})
+                    self._degrade(client, e, "push")
 
-        self._fan(_push_one, list(groups.items()))
+        with self._tracer().root_span("push", kind="client",
+                                      shards=len(self._clients)) as root:
+            self._fan(lambda item: _push_one(item, root.ctx),
+                      list(groups.items()))
         st["push_wire_s"] += time.perf_counter() - t1
         st["pushes"] += 1
 

@@ -26,6 +26,7 @@ worker loops can't tell the wires apart — only the clock can.
 
 from __future__ import annotations
 
+import contextlib
 import http.client
 import json
 import time
@@ -120,6 +121,50 @@ class BinaryTransport:
         self.run_tag = _rt(run_id)
         self.stats = _new_phase_stats()
         self._conn: Optional[http.client.HTTPConnection] = None
+
+    def _rpct(self):
+        """The rpctrace module, imported lazily (net/ stays importable
+        without dragging the obs package in at module load) and cached
+        per transport."""
+        mod = getattr(self, "_rpctrace_mod", None)
+        if mod is None:
+            from sparktorch_tpu.obs import rpctrace as mod
+
+            self._rpctrace_mod = mod
+        return mod
+
+    def _tracer(self):
+        """This transport's tracer, resolved ONCE: the bus is fixed
+        for the transport's life, and re-resolving through the global
+        registry's lock per request would put a process-wide lock hop
+        on the exact hot path the overhead gate bounds."""
+        tracer = getattr(self, "_tracer_cached", None)
+        if tracer is None:
+            tracer = self._tracer_cached = self._rpct().tracer_for(
+                self.telemetry)
+        return tracer
+
+    @contextlib.contextmanager
+    def _trace_root(self, name: str, trace):
+        """Yield the span context this request propagates: the
+        caller's, when one was handed down (a ShardedTransport owns
+        the per-shard hop span and this transport only propagates),
+        else a freshly minted ROOT — a worker-side push/pull against a
+        single server is itself the request."""
+        if trace is not None:
+            yield trace
+            return
+        with self._tracer().root_span(name, kind="client",
+                                      host=self.host,
+                                      port=self.port) as sp:
+            yield sp.ctx
+
+    def _trace_header(self, headers: Dict[str, str], ctx) -> Dict[str, str]:
+        """Inject ``X-Trace-Context`` for sampled requests (head-based
+        sampling: unsampled requests must cost the server nothing)."""
+        if ctx is not None and ctx.sampled:
+            headers[self._rpct().TRACE_HEADER] = ctx.to_header()
+        return headers
 
     def _count_reconnect(self) -> None:
         self.stats["reconnects"] = self.stats.get("reconnects", 0) + 1
@@ -234,36 +279,42 @@ class BinaryTransport:
             tele.counter("transport_run_tag_mismatches_total",
                          labels={"host": self.host, "port": self.port})
 
-    def pull(self, have_version):
+    def pull(self, have_version, _trace=None):
         """``(version, params)`` newer than ``have_version``, or None
         when the server's snapshot is not newer (its 304 reply — the
         ETag-style exchange that costs ~100 header bytes, not a model).
 
         ``have_version`` may be a CALLABLE returning the live value:
-        it is re-read on every reconnect attempt (see ``_request``)."""
+        it is re-read on every reconnect attempt (see ``_request``).
+        ``_trace`` (a sampled SpanContext) propagates a caller-owned
+        request trace instead of minting a root here."""
         st = self.stats
-        t0 = time.perf_counter()
-        status, body, _ = self._request(
-            "GET", "/parameters.bin",
-            headers=lambda: {"X-Have-Version": str(int(
-                have_version() if callable(have_version) else have_version
-            ))},
-            timeout=self.pull_timeout, retry_on_timeout=True,
-        )
-        st["pull_s"] += time.perf_counter() - t0
-        st["pulls"] += 1
-        if status == 304:
-            return None
-        if status != 200:
-            raise TransportError(f"/parameters.bin -> {status}")
-        st["pull_fresh"] += 1
-        st["pull_bytes"] += len(body)
-        self._check_run_tag(body)
-        version, tree = wire.decode(body)
-        return version, tree
+        with self._trace_root("pull", _trace) as tctx:
+            t0 = time.perf_counter()
+            status, body, _ = self._request(
+                "GET", "/parameters.bin",
+                headers=lambda: self._trace_header(
+                    {"X-Have-Version": str(int(
+                        have_version() if callable(have_version)
+                        else have_version
+                    ))}, tctx),
+                timeout=self.pull_timeout, retry_on_timeout=True,
+            )
+            st["pull_s"] += time.perf_counter() - t0
+            st["pulls"] += 1
+            if status == 304:
+                return None
+            if status != 200:
+                raise TransportError(f"/parameters.bin -> {status}")
+            st["pull_fresh"] += 1
+            st["pull_bytes"] += len(body)
+            self._check_run_tag(body)
+            version, tree = wire.decode(body)
+            return version, tree
 
     def pull_delta(self, have_version,
-                   quant: Optional[str] = None) -> Dict[str, Any]:
+                   quant: Optional[str] = None,
+                   _trace=None) -> Dict[str, Any]:
         """Per-tensor delta pull from the fleet's ``/delta.bin`` route.
 
         ``have_version`` (int or callable, re-read per reconnect
@@ -281,38 +332,40 @@ class BinaryTransport:
         refresh the shard map).
         """
         st = self.stats
-        t0 = time.perf_counter()
+        with self._trace_root("pull", _trace) as tctx:
+            t0 = time.perf_counter()
 
-        def _headers() -> Dict[str, str]:
-            hv = have_version() if callable(have_version) else have_version
-            h = {"X-Have-Version": str(int(hv))}
-            if quant:
-                h["X-Pull-Quant"] = quant
-            return h
+            def _headers() -> Dict[str, str]:
+                hv = have_version() if callable(have_version) \
+                    else have_version
+                h = {"X-Have-Version": str(int(hv))}
+                if quant:
+                    h["X-Pull-Quant"] = quant
+                return self._trace_header(h, tctx)
 
-        status, body, rhdrs = self._request(
-            "GET", "/delta.bin", headers=_headers,
-            timeout=self.pull_timeout, retry_on_timeout=True,
-        )
-        st["pull_s"] += time.perf_counter() - t0
-        st["pulls"] += 1
-        out: Dict[str, Any] = {
-            "fresh": False, "version": None, "leaves": {},
-            "leaf_versions": {}, "nbytes": 0,
-            "epoch": _int_header(rhdrs, "X-Slot-Epoch"),
-            "ring_version": _int_header(rhdrs, "X-Ring-Version"),
-        }
-        if status == 304:
+            status, body, rhdrs = self._request(
+                "GET", "/delta.bin", headers=_headers,
+                timeout=self.pull_timeout, retry_on_timeout=True,
+            )
+            st["pull_s"] += time.perf_counter() - t0
+            st["pulls"] += 1
+            out: Dict[str, Any] = {
+                "fresh": False, "version": None, "leaves": {},
+                "leaf_versions": {}, "nbytes": 0,
+                "epoch": _int_header(rhdrs, "X-Slot-Epoch"),
+                "ring_version": _int_header(rhdrs, "X-Ring-Version"),
+            }
+            if status == 304:
+                return out
+            if status != 200:
+                raise TransportError(f"/delta.bin -> {status}")
+            st["pull_fresh"] += 1
+            st["pull_bytes"] += len(body)
+            self._check_run_tag(body)
+            version, leaves, leaf_versions = wire.decode_delta(body)
+            out.update(fresh=True, version=version, leaves=leaves,
+                       leaf_versions=leaf_versions, nbytes=len(body))
             return out
-        if status != 200:
-            raise TransportError(f"/delta.bin -> {status}")
-        st["pull_fresh"] += 1
-        st["pull_bytes"] += len(body)
-        self._check_run_tag(body)
-        version, leaves, leaf_versions = wire.decode_delta(body)
-        out.update(fresh=True, version=version, leaves=leaves,
-                   leaf_versions=leaf_versions, nbytes=len(body))
-        return out
 
     def fetch_json(self, path: str, timeout: Optional[float] = None) -> Any:
         """GET + parse a small JSON control route (``/fleet.json``)
@@ -329,38 +382,50 @@ class BinaryTransport:
         except ValueError as e:
             raise TransportError(f"{path}: invalid JSON: {e}") from e
 
-    def push(self, grads) -> None:
+    def push(self, grads, _trace=None) -> None:
         """Encode (optionally quantize with error feedback) and POST
         the gradient tree. The materialize fence is timed apart from
-        the wire, matching the dill transport's honest accounting."""
+        the wire, matching the dill transport's honest accounting.
+        A sampled trace context (minted here, or handed down via
+        ``_trace``) rides the frame's header extension, with the
+        ENCODE (materialize+quantize+frame) and SOCKET halves
+        attributed as separate child spans."""
         st = self.stats
-        t0 = time.perf_counter()
-        # np.asarray FENCES the device: the gradient compute drains
-        # here, so this term is compute+download, and the request
-        # below is pure wire + server apply.
-        host = _tree_to_host(grads)
-        if self.quant is not None:
-            leaves, _ = wire.quantize_tree(host, self.quant, self._residuals)
-        else:
-            leaves = wire.flatten_tree(host)
-        buffers = wire.encode(leaves, run_tag=self.run_tag)
-        nbytes = wire.frame_nbytes(buffers)
-        t1 = time.perf_counter()
-        st["push_materialize_s"] += t1 - t0
-        # The buffer LIST (not an iterator): http.client scatter-sends
-        # each part, and a connection-level retry can re-iterate it —
-        # an exhausted iterator would under-send the declared length.
-        status, _, _ = self._request(
-            "POST", "/update.bin", body=buffers,
-            headers={"Content-Length": str(nbytes),
-                     "Content-Type": wire.CONTENT_TYPE},
-            timeout=self.timeout,
-        )
-        if status != 200:
-            raise TransportError(f"/update.bin -> {status}")
-        st["push_wire_s"] += time.perf_counter() - t1
-        st["push_bytes"] += nbytes
-        st["pushes"] += 1
+        tracer = self._tracer()
+        with self._trace_root("push", _trace) as tctx:
+            t0 = time.perf_counter()
+            # np.asarray FENCES the device: the gradient compute drains
+            # here, so this term is compute+download, and the request
+            # below is pure wire + server apply.
+            with tracer.child_span("encode", tctx, kind="internal") as _sp:
+                host = _tree_to_host(grads)
+                if self.quant is not None:
+                    leaves, _ = wire.quantize_tree(host, self.quant,
+                                                   self._residuals)
+                else:
+                    leaves = wire.flatten_tree(host)
+                buffers = wire.encode(leaves, run_tag=self.run_tag,
+                                      trace=tctx)
+            nbytes = wire.frame_nbytes(buffers)
+            t1 = time.perf_counter()
+            st["push_materialize_s"] += t1 - t0
+            # The buffer LIST (not an iterator): http.client scatter-
+            # sends each part, and a connection-level retry can
+            # re-iterate it — an exhausted iterator would under-send
+            # the declared length.
+            with tracer.child_span("socket", tctx, kind="internal",
+                                   host=self.host, port=self.port):
+                status, _, _ = self._request(
+                    "POST", "/update.bin", body=buffers,
+                    headers={"Content-Length": str(nbytes),
+                             "Content-Type": wire.CONTENT_TYPE},
+                    timeout=self.timeout,
+                )
+            if status != 200:
+                raise TransportError(f"/update.bin -> {status}")
+            st["push_wire_s"] += time.perf_counter() - t1
+            st["push_bytes"] += nbytes
+            st["pushes"] += 1
 
     def post_loss(self, loss: float) -> bool:
         """Early-stop vote; JSON (the one non-tensor exchange — tiny,

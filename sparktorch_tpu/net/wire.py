@@ -80,6 +80,18 @@ WIRE_VERSION_DELTA = 2
 _HEADER = struct.Struct("<4sBBHqIQ")
 HEADER_SIZE = _HEADER.size
 
+# Flags bit 0: a TRACE-CONTEXT extension follows the fixed header —
+# 16 bytes trace_id + 8 bytes span_id + 1 flag byte (bit 0 = sampled),
+# the distributed-RPC span context of the request this frame belongs
+# to (:mod:`sparktorch_tpu.obs.rpctrace`). Versioned alongside the
+# run-tag bytes: untraced frames carry flags=0 and stay BYTE-IDENTICAL
+# to the pre-trace wire; a pre-trace decoder handed a traced frame
+# fails loudly on its length check (the table offset moved) instead of
+# mis-reading tensors — the same posture as v1-vs-v2 delta frames.
+FLAG_TRACE = 0x01
+_TRACE_EXT = struct.Struct("<16s8sB")
+TRACE_EXT_SIZE = _TRACE_EXT.size
+
 CONTENT_TYPE = "application/x-sparktorch-wire"
 
 Buffers = List[Union[bytes, memoryview]]
@@ -332,7 +344,8 @@ def _encode_node(node: Any, table_out: Any, buffers: Buffers,
 
 def encode(tree_or_leaves: Any, version: int = -1,
            run_tag: int = 0,
-           leaf_versions: Optional[Mapping] = None) -> Buffers:
+           leaf_versions: Optional[Mapping] = None,
+           trace: Optional[Any] = None) -> Buffers:
     """Frame a tree (or pre-flattened/quantized leaves) for the wire.
 
     Returns ``[header+table bytes, buffer, buffer, ...]`` where each
@@ -345,6 +358,14 @@ def encode(tree_or_leaves: Any, version: int = -1,
     frame to wire version 2: each leaf entry carries its per-tensor
     version tag and the tree may be a PARTIAL delta. Leave it None for
     the byte-stable v1 frames old decoders understand.
+
+    ``trace`` (anything with ``trace_id``/``span_id``/``sampled`` —
+    an :class:`~sparktorch_tpu.obs.rpctrace.SpanContext`) embeds the
+    request's distributed-tracing context as the ``FLAG_TRACE`` header
+    extension. Only SAMPLED contexts travel (head-based sampling:
+    unsampled requests must cost the far side nothing); ``None`` or an
+    unsampled context leaves the frame byte-identical to the pre-trace
+    wire.
     """
     if isinstance(tree_or_leaves, list) and (
         not tree_or_leaves
@@ -367,9 +388,18 @@ def encode(tree_or_leaves: Any, version: int = -1,
 
     wire_ver = WIRE_VERSION if leaf_versions is None else WIRE_VERSION_DELTA
     table_bytes = json.dumps(table, separators=(",", ":")).encode()
-    header = _HEADER.pack(MAGIC, wire_ver, 0, int(run_tag) & 0xFFFF,
+    flags = 0
+    ext = b""
+    if trace is not None and getattr(trace, "sampled", False):
+        try:
+            ext = _TRACE_EXT.pack(bytes.fromhex(str(trace.trace_id)),
+                                  bytes.fromhex(str(trace.span_id)), 1)
+        except (ValueError, struct.error) as e:
+            raise WireError(f"malformed trace context {trace!r}") from e
+        flags |= FLAG_TRACE
+    header = _HEADER.pack(MAGIC, wire_ver, flags, int(run_tag) & 0xFFFF,
                           int(version), len(table_bytes), payload_len)
-    return [header + table_bytes, *buffers]
+    return [header + ext + table_bytes, *buffers]
 
 
 def frame_nbytes(buffers: Buffers) -> int:
@@ -396,6 +426,31 @@ def frame_run_tag(data: Union[bytes, bytearray, memoryview]) -> int:
     return int(tag)
 
 
+def frame_trace(data: Union[bytes, bytearray, memoryview]):
+    """The distributed-tracing span context embedded in a frame's
+    ``FLAG_TRACE`` header extension, as an
+    :class:`~sparktorch_tpu.obs.rpctrace.SpanContext` — or None on an
+    untraced frame. Header-only peek like :func:`frame_run_tag` (a
+    server decides whether to open a serve span BEFORE paying the
+    body decode). Raises :class:`WireError` on a non-frame or a
+    truncated extension."""
+    mv = memoryview(data)
+    if len(mv) < HEADER_SIZE:
+        raise WireError(f"frame truncated: {len(mv)} < header {HEADER_SIZE}")
+    magic, _ver, flags, _tag, _v, _t, _p = _HEADER.unpack_from(mv, 0)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if not flags & FLAG_TRACE:
+        return None
+    if len(mv) < HEADER_SIZE + TRACE_EXT_SIZE:
+        raise WireError("frame truncated inside the trace extension")
+    trace_id, span_id, tflags = _TRACE_EXT.unpack_from(mv, HEADER_SIZE)
+    from sparktorch_tpu.obs.rpctrace import SpanContext
+
+    return SpanContext.from_parts(trace_id.hex(), span_id.hex(),
+                                  bool(tflags & 1))
+
+
 def _decode_impl(
     data: Union[bytes, bytearray, memoryview]
 ) -> Tuple[int, Any, Dict[Tuple[str, ...], int]]:
@@ -404,26 +459,31 @@ def _decode_impl(
     mv = memoryview(data)
     if len(mv) < HEADER_SIZE:
         raise WireError(f"frame truncated: {len(mv)} < header {HEADER_SIZE}")
-    magic, wire_ver, _flags, _res, version, table_len, payload_len = (
+    magic, wire_ver, flags, _res, version, table_len, payload_len = (
         _HEADER.unpack_from(mv, 0)
     )
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r}")
     if wire_ver not in (WIRE_VERSION, WIRE_VERSION_DELTA):
         raise WireError(f"unsupported wire version {wire_ver}")
-    if len(mv) != HEADER_SIZE + table_len + payload_len:
+    # The optional trace-context extension shifts the table offset;
+    # its content is the transport layer's business (frame_trace) —
+    # decode only needs to step over it.
+    ext_len = TRACE_EXT_SIZE if flags & FLAG_TRACE else 0
+    body_off = HEADER_SIZE + ext_len
+    if len(mv) != body_off + table_len + payload_len:
         raise WireError(
             f"frame length {len(mv)} != header+table+payload "
-            f"{HEADER_SIZE + table_len + payload_len}"
+            f"{body_off + table_len + payload_len}"
         )
     try:
-        table = json.loads(bytes(mv[HEADER_SIZE:HEADER_SIZE + table_len]))
+        table = json.loads(bytes(mv[body_off:body_off + table_len]))
     except ValueError as e:
         raise WireError(f"corrupt tensor table: {e}") from e
     if not isinstance(table, (dict, list)):
         raise WireError("tensor table is neither object nor leaf")
 
-    payload = mv[HEADER_SIZE + table_len:]
+    payload = mv[body_off + table_len:]
     leaf_versions: Dict[Tuple[str, ...], int] = {}
 
     def read_leaf(entry: list, offset: int,

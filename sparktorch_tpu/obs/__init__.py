@@ -44,6 +44,15 @@ from sparktorch_tpu.obs.collector import (
     scrape_json,
     scrape_text,
 )
+from sparktorch_tpu.obs.rpctrace import (
+    RpcTracer,
+    SpanContext,
+    critical_path,
+    critical_summary,
+    stitch_spans,
+    tracer_for,
+    write_chrome_trace,
+)
 
 __all__ = [
     "Span",
@@ -74,4 +83,11 @@ __all__ = [
     "run_tag",
     "scrape_json",
     "scrape_text",
+    "RpcTracer",
+    "SpanContext",
+    "critical_path",
+    "critical_summary",
+    "stitch_spans",
+    "tracer_for",
+    "write_chrome_trace",
 ]

@@ -171,6 +171,7 @@ class FleetCollector:
                  run_id: Optional[str] = None,
                  poll_interval_s: float = 2.0,
                  jsonl_path: Optional[str] = None,
+                 fallback_jsonl: Optional[str] = None,
                  scrape_timeout_s: float = _SCRAPE_TIMEOUT,
                  poll_parallelism: int = 8,
                  poll_deadline_s: Optional[float] = None,
@@ -184,6 +185,15 @@ class FleetCollector:
         }
         self.poll_interval_s = poll_interval_s
         self.jsonl_path = jsonl_path
+        # HA tail mode: a PEER collector's JSONL sink. When this
+        # collector has never scraped a single rank successfully (and
+        # none of its last-good snapshots exist), ``/gang`` falls back
+        # to the newest merged snapshot in the peer's file — a
+        # secondary collector keeps answering operators from the
+        # primary's sink while the primary (or the whole scrape plane)
+        # is down. Served with ``source: fallback_jsonl`` so a reader
+        # can tell live data from tailed data.
+        self.fallback_jsonl = fallback_jsonl
         self.scrape_timeout_s = scrape_timeout_s
         # Fan-in at scale: scrape targets in PARALLEL (a param-server
         # fleet multiplies targets — N shards + gateway per host; a
@@ -202,6 +212,7 @@ class FleetCollector:
         self._lock = threading.Lock()
         self._gang_xprof: Optional[Dict[str, Any]] = None
         self._xprof_fingerprint: Optional[Tuple] = None
+        self._rpc_doc: Optional[Dict[str, Any]] = None
         self._httpd = None
         self._http_thread: Optional[threading.Thread] = None
         self._poll_stop = threading.Event()
@@ -324,13 +335,21 @@ class FleetCollector:
                     f"(serving last good snapshot)"
                 )
         self._merge_xprof()
+        self._stitch_rpc()
         merged = self.merged_snapshot()
         if self.jsonl_path:
             from sparktorch_tpu.obs.sinks import write_jsonl
 
             try:
+                # The sink record also carries the unioned heartbeat
+                # table (merged_snapshot alone does not — heartbeats
+                # are a /gang-level join): a secondary collector
+                # tailing this file must be able to serve the
+                # straggler/step-skew view, which is exactly what an
+                # operator wants DURING the outage HA mode covers.
                 write_jsonl(self.jsonl_path,
-                            [{"kind": "gang_snapshot", **merged}],
+                            [{"kind": "gang_snapshot", **merged,
+                              "heartbeats": self._merged_heartbeats()}],
                             append=True)
             except OSError as e:
                 _LOG.warning(
@@ -374,6 +393,43 @@ class FleetCollector:
         gang.publish(self.telemetry)
         with self._lock:
             self._gang_xprof = gang.to_dict()
+
+    def _stitch_rpc(self) -> None:
+        """Join every scraped rank's ``rpc_spans`` ring (plus this
+        collector's own, if it records any) into whole-request trees
+        by trace_id — the cross-process half of per-request tracing:
+        a worker's root span and the serving rank's queue-wait/apply
+        spans live on DIFFERENT buses until this stitch. The stitched
+        document (each tree with its computed critical path) is
+        published as this bus's ``rpc_traces`` section, so the JSONL
+        sink, ``/telemetry``, ``/gang``, and ``timeline --rpc`` all
+        see one truth."""
+        from sparktorch_tpu.obs import rpctrace
+
+        spans: List[Dict[str, Any]] = []
+        with self._lock:
+            for st in self._ranks.values():
+                spans.extend(rpctrace.spans_from_snapshot(
+                    st.snapshot or {}))
+        own = self.telemetry.get_section(rpctrace.SECTION)
+        if isinstance(own, dict):
+            spans.extend(own.get("spans") or [])
+        if not spans:
+            return
+        traces = rpctrace.stitch_spans(spans, max_traces=32)
+        doc = {
+            "n_spans": len(spans),
+            "n_traces": len(traces),
+            "traces": traces,
+        }
+        with self._lock:
+            self._rpc_doc = doc
+        self.telemetry.set_section(rpctrace.TRACES_SECTION, doc)
+
+    def rpc_traces(self) -> List[Dict[str, Any]]:
+        """The last stitched whole-request trees (newest first)."""
+        with self._lock:
+            return list((self._rpc_doc or {}).get("traces") or [])
 
     # -- merged views ------------------------------------------------------
 
@@ -431,20 +487,14 @@ class FleetCollector:
         )
         return merged
 
-    def gang_view(self) -> Dict[str, Any]:
-        """The joined gang document ``GET /gang`` serves: scrape
-        status per rank, the unioned heartbeat table (re-aged at read
-        time), the merged xprof budget, and every run_id seen — the
-        cross-rank correlation surface. Reads only the per-rank status
-        and heartbeat/xprof state — it does NOT pay the full series
-        tag-and-merge that ``merged_snapshot`` does (O(ranks), not
-        O(total series), per ``/gang`` poll)."""
-        now = time.time()
+    def _merged_heartbeats(self) -> Dict[str, Any]:
+        """The unioned gang heartbeat table (freshest record per rank
+        across targets sharing a directory) + derived step skew —
+        shared by ``gang_view`` and the JSONL sink record, so a
+        fallback secondary tails the same table ``/gang`` serves."""
         hb_ranks: Dict[str, Any] = {}
         steps: List[int] = []
         with self._lock:
-            status = self._rank_status_locked(now)
-            gang_xprof = self._gang_xprof
             for r, st in self._ranks.items():
                 for hb_rank, rec in ((st.heartbeats or {}).get("ranks")
                                      or {}).items():
@@ -469,13 +519,127 @@ class FleetCollector:
             heartbeats["step_min"] = min(steps)
             heartbeats["step_max"] = max(steps)
             heartbeats["step_skew"] = max(steps) - min(steps)
-        return {
+        return heartbeats
+
+    def gang_view(self) -> Dict[str, Any]:
+        """The joined gang document ``GET /gang`` serves: scrape
+        status per rank, the unioned heartbeat table (re-aged at read
+        time), the merged xprof budget, and every run_id seen — the
+        cross-rank correlation surface. Reads only the per-rank status
+        and heartbeat/xprof state — it does NOT pay the full series
+        tag-and-merge that ``merged_snapshot`` does (O(ranks), not
+        O(total series), per ``/gang`` poll)."""
+        now = time.time()
+        with self._lock:
+            status = self._rank_status_locked(now)
+            gang_xprof = self._gang_xprof
+            rpc_doc = self._rpc_doc
+        if self.fallback_jsonl and not any(
+                s["ok"] or s["scrapes"] for s in status.values()):
+            # HA tail mode: this collector has NEVER landed a scrape
+            # (secondary spun up while the scrape plane is dark) — keep
+            # answering from the peer collector's sink rather than
+            # serving an empty gang.
+            fallback = self._fallback_gang_view(now)
+            if fallback is not None:
+                return fallback
+        heartbeats = self._merged_heartbeats()
+        doc = {
             "run_id": self.run_id,
             "ts": now,
+            "source": "live",
             "ranks": status,
             "run_ids": {r: s.get("run_id") for r, s in status.items()},
             "heartbeats": heartbeats,
             "xprof": gang_xprof,
+        }
+        if rpc_doc:
+            # Condensed per-request view: what an operator wants from
+            # /gang is "which requests, how slow, bounded by what" —
+            # the full trees ride the telemetry section.
+            doc["rpc"] = {
+                "n_traces": rpc_doc.get("n_traces", 0),
+                "n_spans": rpc_doc.get("n_spans", 0),
+                "traces": [
+                    {
+                        "trace_id": t.get("trace_id"),
+                        "name": (t.get("root") or {}).get("name"),
+                        "wall_s": t.get("wall_s"),
+                        "n_spans": t.get("n_spans"),
+                        "status": (t.get("root") or {}).get("status"),
+                        "critical": {
+                            k: (t.get("critical") or {}).get(k)
+                            for k in ("name", "shard", "self_s",
+                                      "fraction")
+                        },
+                    }
+                    for t in (rpc_doc.get("traces") or [])[:8]
+                ],
+            }
+        return doc
+
+    def _fallback_gang_view(self, now: float) -> Optional[Dict[str, Any]]:
+        """Reconstruct a ``/gang`` document from the newest merged
+        snapshot in the peer collector's JSONL sink (``gang_snapshot``
+        records carry rank status + the xprof_gang / rpc_traces
+        sections). None when the file is unreadable or empty — the
+        caller then serves its own (empty) live view. The parsed
+        record is CACHED on the file's (size, mtime) signature: the
+        primary appends one snapshot per poll for hours, and
+        re-parsing a tens-of-MB sink per operator ``/gang`` request
+        would make fallback latency grow with primary uptime."""
+        import os as _os
+
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            st = _os.stat(self.fallback_jsonl)
+            sig = (st.st_size, st.st_mtime_ns)
+            cached = getattr(self, "_fallback_cache", None)
+            if cached is not None and cached[0] == sig:
+                rec = cached[1]
+            else:
+                records = read_jsonl(self.fallback_jsonl)
+                rec = next((r for r in reversed(records)
+                            if r.get("kind") == "gang_snapshot"), None)
+                self._fallback_cache = (sig, rec)
+        except OSError as e:
+            _LOG.warning(
+                f"[sparktorch_tpu:collector] fallback sink "
+                f"{self.fallback_jsonl!r} unreadable: {e}"
+            )
+            return None
+        if rec is None:
+            return None
+        self.telemetry.counter("collector.fallback_serves_total")
+        sections = rec.get("sections") or {}
+        return {
+            "run_id": rec.get("run_id"),
+            "ts": rec.get("ts"),
+            "source": "fallback_jsonl",
+            "fallback_path": self.fallback_jsonl,
+            "fallback_age_s": (now - float(rec["ts"])
+                               if rec.get("ts") is not None else None),
+            "serving_run_id": self.run_id,
+            "ranks": rec.get("ranks") or {},
+            "run_ids": {r: s.get("run_id")
+                        for r, s in (rec.get("ranks") or {}).items()},
+            "heartbeats": rec.get("heartbeats") or {},
+            "xprof": sections.get("xprof_gang"),
+            "rpc": {
+                "n_traces": (sections.get("rpc_traces")
+                             or {}).get("n_traces", 0),
+                "traces": [
+                    {
+                        "trace_id": t.get("trace_id"),
+                        "name": (t.get("root") or {}).get("name"),
+                        "wall_s": t.get("wall_s"),
+                        "critical": t.get("critical"),
+                    }
+                    for t in ((sections.get("rpc_traces")
+                               or {}).get("traces") or [])[:8]
+                ],
+            },
         }
 
     # -- HTTP surface ------------------------------------------------------

@@ -17,6 +17,17 @@ carries the merged budget:
     python -m sparktorch_tpu.obs.timeline --gang host0_trace host1_trace
     python -m sparktorch_tpu.obs.timeline --gang collector_sink.jsonl
 
+``--rpc`` renders PER-REQUEST waterfalls from distributed RPC traces
+(:mod:`sparktorch_tpu.obs.rpctrace`): a telemetry JSONL dump whose
+snapshots carry the ``rpc_spans`` ring, or a fleet collector sink
+whose records carry the already-stitched ``rpc_traces`` section. One
+tree per sampled request — each hop offset on the root's clock, the
+computed critical path starred, the bounding hop (straggler shard
+included) named in the header:
+
+    python -m sparktorch_tpu.obs.timeline --rpc run_telemetry.jsonl
+    python -m sparktorch_tpu.obs.timeline --rpc collector_sink.jsonl
+
 Rendering is pure string-building (testable offline); only the CLI
 entry prints.
 """
@@ -186,6 +197,105 @@ def render_gang_report(gang: Any) -> str:
             lines.append(f"  {fam:<16} {_fmt_ms(sec):>10}"
                          f"  x{counts.get(fam, 0)}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# RPC request-trace rendering (per-request waterfalls)
+# ---------------------------------------------------------------------------
+
+
+def render_rpc_report(traces: List[Dict[str, Any]], top: int = 10,
+                      width: int = 44) -> str:
+    """Per-request waterfalls from stitched RPC trace trees (the
+    :func:`sparktorch_tpu.obs.rpctrace.stitch_spans` output). Each
+    span renders at its offset on the ROOT's clock with a bar scaled
+    to the root wall; spans on the computed critical path are starred,
+    errored spans flagged, and the header names the hop (and shard)
+    that actually bounded the request."""
+    if not traces:
+        return "no rpc traces found\n"
+    lines = [f"rpc traces: {len(traces)}"
+             f" (showing {min(top, len(traces))}, newest first)", ""]
+    for t in traces[:top]:
+        root = t.get("root") or {}
+        wall = float(t.get("wall_s") or root.get("dur_s") or 0.0)
+        crit = t.get("critical") or {}
+        # Condensed /gang docs strip the path; those render unstarred.
+        crit_ids = {e.get("span_id") for e in (crit.get("path") or [])
+                    if e.get("span_id")}
+        head = (f"trace {str(t.get('trace_id'))[:16]}"
+                f"  {root.get('name')}  {_fmt_ms(wall)}"
+                f"  {t.get('n_spans')} spans")
+        if root.get("status") == "error":
+            head += "  [ERROR]"
+        if root.get("forced"):
+            head += "  [slo-forced]"
+        if crit.get("name"):
+            shard = (f", shard {crit['shard']}"
+                     if crit.get("shard") is not None else "")
+            head += (f"   bound by: {crit['name']}{shard}"
+                     f" ({100 * float(crit.get('fraction') or 0):.0f}%"
+                     f" of wall)")
+        lines.append(head)
+        t0 = float(root.get("ts", 0.0))
+
+        def _bar(off_s: float, dur_s: float) -> str:
+            if wall <= 0:
+                return "." * width
+            pos = min(int(round(width * max(off_s, 0.0) / wall)),
+                      width - 1)
+            n = max(1, int(round(width * dur_s / wall)))
+            n = min(n, width - pos)
+            return "." * pos + "#" * n + "." * (width - pos - n)
+
+        def _walk(node: Dict[str, Any], depth: int) -> None:
+            off = float(node.get("ts", 0.0)) - t0
+            name = str(node.get("name"))
+            ann = node.get("ann") or {}
+            if ann.get("shard") is not None:
+                name += f" shard={ann['shard']}"
+            mark = "*" if node.get("span_id") in crit_ids else " "
+            err = "!" if node.get("status") == "error" else " "
+            dur = float(node.get("dur_s") or 0.0)
+            pad = max(30 - 2 * depth, 1)
+            lines.append(
+                f" {mark}{err}{'  ' * depth}{name:<{pad}}"
+                f" {_fmt_ms(off):>9} +{_fmt_ms(dur):>9}"
+                f" |{_bar(off, dur)}|"
+            )
+            for child in node.get("children") or []:
+                _walk(child, depth + 1)
+
+        _walk(root, 0)
+        for extra in t.get("extra_roots") or []:
+            _walk(extra, 0)
+        for orphan in t.get("orphans") or []:
+            lines.append(f"  ~ orphan hop: {orphan.get('name')}"
+                         f" +{_fmt_ms(float(orphan.get('dur_s') or 0))}"
+                         f" (parent span not scraped)")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _rpc_from_jsonl(records: List[Dict[str, Any]]
+                    ) -> Optional[List[Dict[str, Any]]]:
+    """Stitched request trees out of a JSONL file: the newest record
+    carrying an already-stitched ``rpc_traces`` section (a collector
+    sink) wins; otherwise every record's raw ``rpc_spans`` rings are
+    pooled and stitched here (a per-process telemetry dump — possibly
+    several processes' snapshots appended to one file)."""
+    from sparktorch_tpu.obs import rpctrace
+
+    for rec in reversed(records):
+        section = (rec.get("sections") or {}).get(rpctrace.TRACES_SECTION)
+        if isinstance(section, dict) and section.get("traces"):
+            return list(section["traces"])
+    spans: List[Dict[str, Any]] = []
+    for rec in records:
+        spans.extend(rpctrace.spans_from_snapshot(rec))
+    if not spans:
+        return None
+    return rpctrace.stitch_spans(spans)
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +476,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(tune_result.json, or a telemetry JSONL "
                              "carrying the xprof_tune section): "
                              "measured ranking + prune decisions")
+    parser.add_argument("--rpc", action="store_true",
+                        help="render per-request RPC trace waterfalls "
+                             "from a telemetry JSONL dump (rpc_spans) "
+                             "or a collector sink (stitched "
+                             "rpc_traces): one tree per sampled "
+                             "request, critical path starred")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis dict as JSON")
     parser.add_argument("--top", type=int, default=10,
@@ -375,11 +491,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     args.path = args.paths[0]
 
-    if args.gang and args.tune:
-        print("error: --gang and --tune are different reports; pick one")
+    if sum((args.gang, args.tune, args.rpc)) > 1:
+        print("error: --gang, --tune and --rpc are different reports; "
+              "pick one")
         return 2
     if args.tune:
         return _main_tune(args)
+    if args.rpc:
+        return _main_rpc(args)
     if args.gang:
         return _main_gang(args)
     if len(args.paths) > 1:
@@ -448,6 +567,35 @@ def _main_tune(args) -> int:
             return 1
     print(json.dumps(doc) if args.json else render_tune_report(doc),
           end="" if not args.json else "\n")
+    return 0
+
+
+def _main_rpc(args) -> int:
+    """--rpc: request waterfalls from a telemetry dump or a collector
+    sink."""
+    if len(args.paths) > 1:
+        print("error: --rpc renders one JSONL file at a time")
+        return 2
+    path = args.paths[0]
+    if not _looks_like_jsonl(path):
+        print("error: --rpc reads a telemetry/collector .jsonl "
+              "(rpc_spans or rpc_traces)")
+        return 2
+    from sparktorch_tpu.obs.sinks import read_jsonl
+
+    try:
+        records = read_jsonl(path)
+    except OSError as e:
+        print(f"error: {e}")
+        return 1
+    traces = _rpc_from_jsonl(records)
+    if not traces:
+        print(f"no rpc spans (sections.rpc_spans / rpc_traces) in {path}")
+        return 1
+    print(json.dumps(traces) if args.json
+          else render_rpc_report(traces, top=args.top), end="")
+    if args.json:
+        print()
     return 0
 
 

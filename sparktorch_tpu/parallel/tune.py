@@ -55,8 +55,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
+import os
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
 
 from sparktorch_tpu.obs.log import get_logger
 from sparktorch_tpu.parallel.mesh import ALL_AXES, AXIS_DP, MeshConfig
@@ -224,6 +227,15 @@ def transformer_workload(cfg, global_batch: int,
 # much larger equivalent.
 DEFAULT_ALPHA_BYTES = {"cpu": 1 << 20, "gpu": 1 << 18, "tpu": 1 << 17}
 
+# Explicit override wins over both the probe and the table (the knob
+# the ROADMAP's alpha-calibration follow-up promised to keep).
+ALPHA_ENV = "SPARKTORCH_TPU_TUNE_ALPHA_BYTES"
+
+# One probe per (backend, device-count) per process: the measurement
+# costs two tiny compiles (~1-2s on the CPU rig), and every
+# mesh="auto" call in a session shares the same rig.
+_ALPHA_PROBE_CACHE: Dict[Tuple[str, int], float] = {}
+
 
 def alpha_bytes_for_backend(backend: Optional[str] = None) -> float:
     if backend is None:
@@ -235,6 +247,102 @@ def alpha_bytes_for_backend(backend: Optional[str] = None) -> float:
             backend = "cpu"
     return float(DEFAULT_ALPHA_BYTES.get(backend,
                                          DEFAULT_ALPHA_BYTES["tpu"]))
+
+
+def calibrate_alpha_bytes(devices: Optional[Sequence[Any]] = None,
+                          big_nbytes: int = 4 << 20,
+                          repeats: int = 7) -> float:
+    """Ground the per-launch alpha in a MEASUREMENT instead of the
+    order-of-magnitude table: time one TINY all-reduce (its wall is
+    ~pure launch/rendezvous latency) and one BIG one (bandwidth-
+    dominated), derive the rig's collective bandwidth from their
+    difference, and convert the tiny latency to equivalent bytes —
+    the LogP alpha x beta product the cost model's ``total_cost``
+    wants. MIN of ``repeats`` timed runs after a compile+warmup pass:
+    first-dispatch walls on this rig are 3-10x inflated, and the
+    cpu-share scheduler lands whole runs in slow epochs — the fastest
+    observed run is the only stable estimate of what the collective
+    costs when the rig isn't fighting itself (medians here swung 6x
+    between processes).
+
+    Clamped to [16KB, 16MB]: a probe gone sideways (scheduler spike,
+    1-device world) must perturb the ranking, not capsize it. Raises
+    on no/one device — callers fall back to the table."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sparktorch_tpu.train.step import shard_map_compat
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n < 2:
+        raise ValueError("alpha probe needs >= 2 devices")
+    key = (str(devices[0].platform), n)
+    cached = _ALPHA_PROBE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    mesh = Mesh(np.array(devices), ("probe",))
+
+    def _timed_psum(per_dev_elems: int) -> float:
+        fn = jax.jit(shard_map_compat(
+            lambda x: jax.lax.psum(x, "probe"), mesh=mesh,
+            in_specs=P("probe"), out_specs=P(),
+        ))
+        x = jnp.zeros((n, per_dev_elems), jnp.float32)
+        fn(x).block_until_ready()  # compile + warmup outside the clock
+        walls = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            walls.append(time.perf_counter() - t0)
+        return float(np.min(walls))
+
+    t_tiny = _timed_psum(1)
+    big_per_dev = max(1, int(big_nbytes) // 4)
+    t_big = _timed_psum(big_per_dev)
+    # Model-consistent byte count for the big probe: the same ring
+    # all-reduce accounting predict_comm_bytes uses (2(n-1)/n x shard
+    # bytes per device, summed over devices) — alpha must come out in
+    # the units the prune key adds it to.
+    model_bytes = n * (2.0 * (n - 1) / n) * big_per_dev * 4.0
+    bandwidth = model_bytes / max(t_big - t_tiny, 1e-6)
+    alpha = t_tiny * bandwidth
+    alpha = float(min(max(alpha, 1 << 14), 1 << 24))
+    _ALPHA_PROBE_CACHE[key] = alpha
+    _LOG.info(
+        f"[sparktorch_tpu:tune] alpha probe: tiny all-reduce "
+        f"{t_tiny * 1e3:.3f}ms, {big_nbytes >> 20}MB all-reduce "
+        f"{t_big * 1e3:.3f}ms -> alpha {alpha / 1e6:.2f}MB-eq "
+        f"(table default {alpha_bytes_for_backend() / 1e6:.2f}MB-eq)"
+    )
+    return alpha
+
+
+def resolve_alpha_bytes(devices: Optional[Sequence[Any]] = None
+                        ) -> Tuple[float, str]:
+    """The alpha the search should use, with its provenance:
+    ``(value, 'env' | 'probe' | 'default')``. Priority: the env
+    override, then the per-rig micro-probe, then the backend table
+    (probe failure degrades to the table with a warning — calibration
+    must never kill a search)."""
+    env = os.environ.get(ALPHA_ENV)
+    if env:
+        try:
+            return float(env), "env"
+        except ValueError:
+            _LOG.warning(
+                f"[sparktorch_tpu:tune] bad {ALPHA_ENV}={env!r}; ignoring"
+            )
+    try:
+        return calibrate_alpha_bytes(devices), "probe"
+    except Exception as e:
+        _LOG.warning(
+            f"[sparktorch_tpu:tune] alpha probe failed "
+            f"({type(e).__name__}: {e}); using the backend table"
+        )
+        return alpha_bytes_for_backend(), "default"
 
 
 def predict_comm_bytes(config: MeshConfig, shape: WorkloadShape,
@@ -398,6 +506,8 @@ class TuneResult:
     candidates_dropped: int = 0  # past the max_candidates cap (logged)
     caps: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
     run_id: Optional[str] = None
+    alpha_bytes: float = 0.0     # the per-launch alpha the prune used
+    alpha_source: str = "default"  # arg | env | probe | default
 
     def best_config(self) -> MeshConfig:
         sizes = {a: int(self.best.get(a, 1)) for a in ALL_AXES}
@@ -440,6 +550,8 @@ class TuneResult:
             "candidates_dropped": self.candidates_dropped,
             "wall_s": self.wall_s,
             "exposed_weight": self.exposed_weight,
+            "alpha_bytes": self.alpha_bytes,
+            "alpha_source": self.alpha_source,
             "caps": {k: list(v) for k, v in self.caps.items()},
             "n_candidates": len(self.candidates),
             "n_measured": sum(c.status == STATUS_MEASURED
@@ -474,6 +586,8 @@ class TuneResult:
             caps={k: [int(x) for x in v]
                   for k, v in (d.get("caps") or {}).items()},
             run_id=d.get("run_id"),
+            alpha_bytes=float(d.get("alpha_bytes", 0.0)),
+            alpha_source=str(d.get("alpha_source", "default")),
         )
 
     def save(self, path: str) -> str:
@@ -806,8 +920,11 @@ def autotune(
             f"no legal mesh for {n_devices} devices / batch "
             f"{global_batch} under caps {caps}"
         )
+    alpha_source = "arg"
     if alpha_bytes is None:
-        alpha_bytes = alpha_bytes_for_backend()
+        # Per-rig calibration: env override > one-time micro-probe
+        # (a tiny all-reduce timed at search start) > backend table.
+        alpha_bytes, alpha_source = resolve_alpha_bytes(devices)
     candidates = [
         Candidate(axes=c.resolve(n_devices),
                   predicted=predict_comm_bytes(c, shape, n_devices,
@@ -952,6 +1069,8 @@ def autotune(
         exposed_weight=exposed_weight,
         caps={k: list(v) for k, v in caps.items()},
         run_id=getattr(telemetry, "run_id", None),
+        alpha_bytes=float(alpha_bytes),
+        alpha_source=alpha_source,
     )
     result.publish(telemetry)
     if artifact_path:

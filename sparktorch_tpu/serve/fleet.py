@@ -196,13 +196,17 @@ class ParamShardServer:
     # -- gradient path -----------------------------------------------------
 
     def push_gradients(self, grads, wait: bool = True,
-                       timeout: float = 60.0) -> threading.Event:
+                       timeout: float = 60.0,
+                       trace_ctx=None) -> threading.Event:
         """Enqueue a gradient PARTIAL (nested subtree or ``{path:
         array}``) for the writer thread; same wait/FIFO semantics as
         the single server. Returns the apply-completion event either
         way, so a scatter caller can enqueue on every shard FIRST and
         wait on the events together (latency = max of shard applies,
-        not their sum)."""
+        not their sum). ``trace_ctx`` rides the queue item so the
+        writer thread attributes this request's queue-wait and apply
+        as child spans — the single-writer queue is exactly where
+        sharded p99 hides."""
         if self._failed is not None:
             raise RuntimeError(
                 f"param shard {self.shard_id} failed"
@@ -224,7 +228,8 @@ class ParamShardServer:
                 raise ShardStopped(
                     f"param shard {self.shard_id} is stopped"
                 )
-            self._queue.put((flat, done))
+            self._queue.put((flat, done, trace_ctx,
+                             time.time(), time.perf_counter()))
         self.telemetry.counter("param_server.pushes", labels=self._labels)
         if wait and not done.wait(timeout):
             raise TimeoutError(
@@ -233,14 +238,22 @@ class ParamShardServer:
         return done
 
     def _apply_loop(self) -> None:
+        from sparktorch_tpu.obs.rpctrace import tracer_for
+
+        tracer = tracer_for(self.telemetry)
         while self._running:
             try:
-                flat, done = self._queue.get(timeout=0.1)
+                flat, done, tctx, enq_ts, enq_t0 = self._queue.get(
+                    timeout=0.1)
             except queue.Empty:
                 continue
             try:
                 t0 = time.perf_counter()
-                with self._state_lock:
+                tracer.record("queue_wait", tctx, enq_ts, t0 - enq_t0,
+                              kind="server", shard=self.shard_id)
+                with tracer.child_span("apply", tctx, kind="server",
+                                       shard=self.shard_id), \
+                        self._state_lock:
                     _version, params, _vers = self.slot.read_leaves()
                     owned: Dict[str, Path] = {}
                     grads: Dict[str, Any] = {}
@@ -431,7 +444,7 @@ class ParamShardServer:
         with self._enqueue_lock:
             while True:
                 try:
-                    _flat, done = self._queue.get_nowait()
+                    _flat, done = self._queue.get_nowait()[:2]
                 except queue.Empty:
                     break
                 if done is not None:
@@ -489,8 +502,9 @@ class _GatewayFacade:
         self.telemetry = fleet.telemetry
 
     def push_gradients(self, grads, wait: bool = True,
-                       timeout: float = 60.0) -> None:
-        self._fleet.scatter_push(grads, wait=wait, timeout=timeout)
+                       timeout: float = 60.0, trace_ctx=None) -> None:
+        self._fleet.scatter_push(grads, wait=wait, timeout=timeout,
+                                 trace_ctx=trace_ctx)
 
     def post_loss(self, loss: float) -> bool:
         return self._fleet.post_loss(loss)
@@ -753,13 +767,17 @@ class ParamServerFleet:
     # -- driver-side ParameterServer surface -------------------------------
 
     def scatter_push(self, grads, wait: bool = True,
-                     timeout: float = 60.0) -> None:
+                     timeout: float = 60.0, trace_ctx=None) -> None:
         """Split a gradient tree (nested, or flat ``{path: array}`` —
         partials welcome) by ring ownership and push each piece to its
         shard (the gateway's legacy-push path). A shard drained
         between the ring snapshot and the push fast-fails with
         :class:`ShardStopped`; the partial re-routes once against the
-        refreshed ring (its leaves moved with the drain)."""
+        refreshed ring (its leaves moved with the drain).
+        ``trace_ctx`` (the gateway serve span's context) fans out to
+        every shard writer, whose queue-wait/apply spans come back
+        annotated with their shard id — the gateway hop of a traced
+        legacy push stays attributable."""
         if isinstance(grads, Mapping) and any(
             isinstance(k, tuple) for k in grads
         ):
@@ -781,7 +799,7 @@ class ParamServerFleet:
                     if paths:
                         events.append((sid, shards[sid].push_gradients(
                             {p: flat[p] for p in paths}, wait=False,
-                            timeout=timeout,
+                            timeout=timeout, trace_ctx=trace_ctx,
                         )))
                         # Only landed partials leave the retry set — a
                         # blind full retry would double-apply on the

@@ -50,6 +50,7 @@ from sparktorch_tpu.obs import (
     Telemetry,
     render_prometheus,
 )
+from sparktorch_tpu.obs import rpctrace as _rpctrace
 from sparktorch_tpu.utils.early_stopper import EarlyStopping
 from sparktorch_tpu.utils.locks import VersionedSlot
 from sparktorch_tpu.utils.serde import ModelSpec, deserialize_model
@@ -153,7 +154,7 @@ class ParameterServer:
     # ------------------------------------------------------------------
 
     def push_gradients(self, grads, wait: bool = True,
-                       timeout: float = 60.0) -> None:
+                       timeout: float = 60.0, trace_ctx=None) -> None:
         """Enqueue a gradient pytree for the writer thread.
 
         Parity: ``POST /update`` (server.py:125-147) — the reference
@@ -164,31 +165,45 @@ class ParameterServer:
         FIFO-serialized by the single writer thread; workers never
         barrier against each other (hogwild semantics preserved).
         ``wait=False`` gives fully fire-and-forget pushes.
+
+        ``trace_ctx`` (a sampled span context from the wire) rides the
+        queue item so the writer thread can attribute THIS request's
+        queue-wait and apply as child spans — the split that tells a
+        slow push apart from a backed-up writer.
         """
         if self._failed is not None:
             raise RuntimeError("parameter server failed") from self._failed
         done = threading.Event() if wait else None
-        self._queue.put((grads, done))
+        self._queue.put((grads, done, trace_ctx,
+                         time.time(), time.perf_counter()))
         self.telemetry.counter("param_server.pushes")
         self.telemetry.gauge("param_server.queue_depth", self._queue.qsize())
         if done is not None and not done.wait(timeout):
             raise TimeoutError("parameter server apply timed out")
 
     def _apply_loop(self):
+        tracer = _rpctrace.tracer_for(self.telemetry)
         while self._running:
             try:
-                grads, done = self._queue.get(timeout=0.1)
+                grads, done, tctx, enq_ts, enq_t0 = self._queue.get(
+                    timeout=0.1)
             except queue.Empty:
                 continue
             try:
                 t0 = time.perf_counter()
-                version, params = self.slot.read()
-                grads = jax.device_put(grads, self.device)
-                new_params, new_opt = self._apply_fn(
-                    params, self._opt_state, grads
-                )
-                self._opt_state = new_opt
-                self.slot.swap(new_params)
+                # Queue-wait attribution: enqueue happened on a handler
+                # thread, the pop here — the after-the-fact record is
+                # the only honest way to span it.
+                tracer.record("queue_wait", tctx, enq_ts, t0 - enq_t0,
+                              kind="server")
+                with tracer.child_span("apply", tctx, kind="server"):
+                    version, params = self.slot.read()
+                    grads = jax.device_put(grads, self.device)
+                    new_params, new_opt = self._apply_fn(
+                        params, self._opt_state, grads
+                    )
+                    self._opt_state = new_opt
+                    self.slot.swap(new_params)
                 self._applied += 1
                 self.telemetry.counter("param_server.applies")
                 self.telemetry.observe("param_server.apply_s",
@@ -379,6 +394,13 @@ class ParamServerHttp:
         from sparktorch_tpu.obs.collector import run_tag as _run_tag
 
         server_tag = _run_tag(ps.telemetry.run_id)
+        # Request tracing: sampled span contexts arrive as the binary
+        # frame's trace extension or the X-Trace-Context header; every
+        # handler contributes a SERVE child span (+ decode/render/
+        # queue_wait/apply below it) on the server's own bus — the
+        # collector stitches them back under the worker's root by
+        # trace_id.
+        tracer = _rpctrace.tracer_for(ps.telemetry)
 
         def _cached_body(fmt: str):
             """(version, body) from ONE slot read — the handler's
@@ -437,6 +459,14 @@ class ParamServerHttp:
             if shard_label is None:
                 return False
             act = _chaos.fire("fleet.shard", shard=shard_label, route=route)
+            if act and act.get("delay"):
+                # Straggler-shard fault: the reply is correct, just
+                # late. Slept BEFORE the route's serve span starts, so
+                # a traced request sees it as the shard HOP's self
+                # time (client-side `shard_pull` span) — network-shaped
+                # latency lands on the hop, server work on `serve`, and
+                # the critical path names this shard either way.
+                time.sleep(float(act["delay"]))
             if act and act.get("die"):
                 # stop() from a separate thread: it joins handler
                 # machinery this very thread is part of.
@@ -466,6 +496,29 @@ class ParamServerHttp:
                 if body:
                     self.wfile.write(body)
 
+            def _trace_ctx(self, raw: Optional[bytes] = None):
+                """The request's span context: the binary frame's
+                trace extension when a body is given (the push path —
+                the frame is authoritative), else the HTTP header.
+                None (untraced) on anything absent or malformed — a
+                garbled context must never fail a request."""
+                if raw:
+                    try:
+                        ctx = binwire.frame_trace(raw)
+                    except binwire.WireError:
+                        ctx = None
+                    if ctx is not None:
+                        return ctx
+                return _rpctrace.SpanContext.from_header(
+                    self.headers.get(_rpctrace.TRACE_HEADER))
+
+            def _serve_span(self, route: str, ctx):
+                ann = {"route": route}
+                if shard_label is not None:
+                    ann["shard"] = shard_label
+                return tracer.child_span("serve", ctx, kind="server",
+                                         **ann)
+
             def _delta_headers(self) -> dict:
                 """Resync metadata on EVERY delta reply (304 too): the
                 slot epoch catches rebuilt server state, the ring
@@ -486,30 +539,36 @@ class ParamServerHttp:
                                      labels={"route": route})
                 if route == "/delta.bin" \
                         and hasattr(ps, "render_delta"):
-                    t0 = time.perf_counter()
-                    have = int(self.headers.get("X-Have-Version", "-1"))
-                    quant = self.headers.get("X-Pull-Quant") or None
-                    try:
-                        _version, body = ps.render_delta(
-                            have, quant=quant, run_tag=server_tag
-                        )
-                    except ValueError:
-                        self._send(400)
-                        return
-                    hdrs = self._delta_headers()
-                    if body is None:
-                        self._send(304, extra_headers=hdrs)
-                        _record_wire(route, "tx", 0,
+                    with self._serve_span(route, self._trace_ctx()) as ssp:
+                        t0 = time.perf_counter()
+                        have = int(self.headers.get("X-Have-Version",
+                                                    "-1"))
+                        quant = self.headers.get("X-Pull-Quant") or None
+                        try:
+                            with tracer.child_span("render", ssp.ctx,
+                                                   kind="server"):
+                                _version, body = ps.render_delta(
+                                    have, quant=quant,
+                                    run_tag=server_tag
+                                )
+                        except ValueError:
+                            self._send(400)
+                            return
+                        hdrs = self._delta_headers()
+                        if body is None:
+                            self._send(304, extra_headers=hdrs)
+                            _record_wire(route, "tx", 0,
+                                         time.perf_counter() - t0)
+                            return
+                        act = _chaos.fire("param_server.pull",
+                                          route=route)
+                        if act and act.get("truncate"):
+                            body = body[: max(1, len(body) // 2)]
+                        self._send(200, body,
+                                   content_type=binwire.CONTENT_TYPE,
+                                   extra_headers=hdrs)
+                        _record_wire(route, "tx", len(body),
                                      time.perf_counter() - t0)
-                        return
-                    act = _chaos.fire("param_server.pull", route=route)
-                    if act and act.get("truncate"):
-                        body = body[: max(1, len(body) // 2)]
-                    self._send(200, body,
-                               content_type=binwire.CONTENT_TYPE,
-                               extra_headers=hdrs)
-                    _record_wire(route, "tx", len(body),
-                                 time.perf_counter() - t0)
                     return
                 if route in extra_json:
                     try:
@@ -523,32 +582,38 @@ class ParamServerHttp:
                 if route == "/":
                     self._send(200, b"sparktorch-tpu parameter server")
                 elif route in ("/parameters", "/parameters.bin"):
-                    t0 = time.perf_counter()
-                    have = int(self.headers.get("X-Have-Version", "-1"))
-                    binary = route.endswith(".bin")
-                    version, body = _cached_body("bin" if binary
-                                                 else "dill")
-                    if version <= have:
-                        # 304 on the binary wire (true HTTP semantics);
-                        # the dill route keeps its original 204 so old
-                        # clients stay byte-compatible.
-                        self._send(304 if binary else 204)
-                        _record_wire(route, "tx", 0,
-                                     time.perf_counter() - t0)
-                    else:
-                        act = _chaos.fire("param_server.pull",
-                                          route=route)
-                        if act and act.get("truncate"):
-                            # Injected torn response: the declared
-                            # length is honest for the bytes sent, so
-                            # the CLIENT'S frame check (WireError on a
-                            # short payload) is what must catch it.
-                            body = body[: max(1, len(body) // 2)]
-                        self._send(200, body,
-                                   content_type=binwire.CONTENT_TYPE
-                                   if binary else None)
-                        _record_wire(route, "tx", len(body),
-                                     time.perf_counter() - t0)
+                    with self._serve_span(route, self._trace_ctx()) as ssp:
+                        t0 = time.perf_counter()
+                        have = int(self.headers.get("X-Have-Version",
+                                                    "-1"))
+                        binary = route.endswith(".bin")
+                        with tracer.child_span("render", ssp.ctx,
+                                               kind="server"):
+                            version, body = _cached_body(
+                                "bin" if binary else "dill")
+                        if version <= have:
+                            # 304 on the binary wire (true HTTP
+                            # semantics); the dill route keeps its
+                            # original 204 so old clients stay
+                            # byte-compatible.
+                            self._send(304 if binary else 204)
+                            _record_wire(route, "tx", 0,
+                                         time.perf_counter() - t0)
+                        else:
+                            act = _chaos.fire("param_server.pull",
+                                              route=route)
+                            if act and act.get("truncate"):
+                                # Injected torn response: the declared
+                                # length is honest for the bytes sent,
+                                # so the CLIENT'S frame check (WireError
+                                # on a short payload) is what must
+                                # catch it.
+                                body = body[: max(1, len(body) // 2)]
+                            self._send(200, body,
+                                       content_type=binwire.CONTENT_TYPE
+                                       if binary else None)
+                            _record_wire(route, "tx", len(body),
+                                         time.perf_counter() - t0)
                 elif route == "/metrics":
                     text = render_prometheus(ps.telemetry.snapshot())
                     self._send(200, text.encode(),
@@ -572,42 +637,57 @@ class ParamServerHttp:
                 length = int(self.headers.get("Content-Length", "0"))
                 raw = self.rfile.read(length)
                 if route == "/update":
-                    t0 = time.perf_counter()
-                    try:
-                        # Chaos 500s fire here — inside the try, so
-                        # the forced error takes the same path a real
-                        # apply failure would (a 500, nothing else).
-                        _chaos.fire("param_server.update", route=route)
-                        ps.push_gradients(dill.loads(raw))
-                        self._send(200, b"OK")
-                        _record_wire(route, "rx", len(raw),
-                                     time.perf_counter() - t0)
-                    except Exception:
-                        self._send(500)
+                    with self._serve_span(route, self._trace_ctx()) as ssp:
+                        t0 = time.perf_counter()
+                        try:
+                            # Chaos 500s fire here — inside the try, so
+                            # the forced error takes the same path a
+                            # real apply failure would (a 500, nothing
+                            # else).
+                            _chaos.fire("param_server.update",
+                                        route=route)
+                            with tracer.child_span("decode", ssp.ctx,
+                                                   kind="server"):
+                                grads = dill.loads(raw)
+                            ps.push_gradients(grads, trace_ctx=ssp.ctx)
+                            self._send(200, b"OK")
+                            _record_wire(route, "rx", len(raw),
+                                         time.perf_counter() - t0)
+                        except Exception:
+                            ssp.annotate(http_status=500)
+                            self._send(500)
                 elif route == "/update.bin":
-                    t0 = time.perf_counter()
-                    try:
-                        _version, grads = binwire.decode(raw)
-                        frame_tag = binwire.frame_run_tag(raw)
-                    except binwire.WireError:
-                        # A malformed frame is the CLIENT's bug (or a
-                        # truncated send): 400, and never counted
-                        # against the server's tolerated apply errors.
-                        self._send(400)
-                        return
-                    if frame_tag and server_tag \
-                            and frame_tag != server_tag:
-                        ps.telemetry.counter(
-                            "param_server.run_tag_mismatches_total"
-                        )
-                    try:
-                        _chaos.fire("param_server.update", route=route)
-                        ps.push_gradients(grads)
-                        self._send(200, b"OK")
-                        _record_wire(route, "rx", len(raw),
-                                     time.perf_counter() - t0)
-                    except Exception:
-                        self._send(500)
+                    with self._serve_span(route,
+                                          self._trace_ctx(raw)) as ssp:
+                        t0 = time.perf_counter()
+                        try:
+                            with tracer.child_span("decode", ssp.ctx,
+                                                   kind="server"):
+                                _version, grads = binwire.decode(raw)
+                            frame_tag = binwire.frame_run_tag(raw)
+                        except binwire.WireError:
+                            # A malformed frame is the CLIENT's bug (or
+                            # a truncated send): 400, and never counted
+                            # against the server's tolerated apply
+                            # errors.
+                            ssp.annotate(http_status=400)
+                            self._send(400)
+                            return
+                        if frame_tag and server_tag \
+                                and frame_tag != server_tag:
+                            ps.telemetry.counter(
+                                "param_server.run_tag_mismatches_total"
+                            )
+                        try:
+                            _chaos.fire("param_server.update",
+                                        route=route)
+                            ps.push_gradients(grads, trace_ctx=ssp.ctx)
+                            self._send(200, b"OK")
+                            _record_wire(route, "rx", len(raw),
+                                         time.perf_counter() - t0)
+                        except Exception:
+                            ssp.annotate(http_status=500)
+                            self._send(500)
                 elif route == "/losses":
                     stop = ps.post_loss(dill.loads(raw))
                     self._send(200, dill.dumps({"stop": bool(stop)}))

@@ -46,13 +46,24 @@ if os.environ.get("SPARKTORCH_TPU_TEST_FASTCOMPILE"):
 # for non-test runs that opt in.
 # Full-suite trial, 2026-08-03 (the ROADMAP recheck's next step): RED.
 # `SPARKTORCH_TPU_TEST_CACHE=<dir> make test-fast` segfaults
-# deterministically ~20s in, inside tests/test_checkpoint.py
-# (test_resume_exactness on one run, test_streaming_trainer_
-# checkpoint_resume from a COLD cache dir on another) — the crash the
-# recheck's two shard_map/dp-mesh repro shapes missed lives on the
-# checkpoint-restore path, and a cold cache reproduces it (same-
-# session entries, not stale ones). The default therefore STAYS off;
-# do not flip it until a full `make test-fast` survives twice.
+# deterministically ~20s in, inside tests/test_checkpoint.py.
+# BISECTED (same day): the crasher is
+# tests/test_checkpoint.py::test_streaming_trainer_checkpoint_resume,
+# and the trigger is ANY earlier in-process orbax restore: every test
+# of the file passes ALONE (cold cache each), the save-only pair
+# (test_checkpoint_cadence_under_fused_stepping -> streaming) passes,
+# but every restore-first pair aborts inside the streaming test —
+# including test_model_save_load -> streaming, where the predecessor
+# only does load_model (orbax restore, NO training, NO collectives).
+# Reverse order (streaming first, restorer second) is green. So the
+# repro is: orbax restore anywhere in the process, THEN the streaming
+# trainer compiling/dispatching its collective programs with the
+# persistent cache armed -> SIGABRT in dispatch. (Consistent with
+# utils/checkpoint.py having to disarm a runtime-enabled cache after
+# restore for non-test runs — the restore leaves the runtime in a
+# state where cache-mediated collective executables abort.) The
+# default therefore STAYS off; do not flip it until a full
+# `make test-fast` survives twice.
 # SPARKTORCH_TPU_TEST_CACHE=<dir> opts a session into a cache dir (at
 # your own risk, e.g. on a TPU backend where the bug doesn't bite).
 _CACHE_DIR = os.environ.get("SPARKTORCH_TPU_TEST_CACHE")

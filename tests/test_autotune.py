@@ -13,13 +13,16 @@ import pytest
 
 from sparktorch_tpu.parallel.mesh import MeshConfig
 from sparktorch_tpu.parallel.tune import (
+    ALPHA_ENV,
     Candidate,
     TuneResult,
     WorkloadShape,
     autotune,
+    calibrate_alpha_bytes,
     enumerate_candidates,
     mesh_label,
     predict_comm_bytes,
+    resolve_alpha_bytes,
     score_analysis,
     transformer_caps,
     transformer_workload,
@@ -362,6 +365,10 @@ def test_tune_result_artifact_roundtrip(tmp_path):
         doc = json.load(f)
     assert doc["kind"] == "tune"
     assert doc["n_pruned"] == 6 and len(doc["candidates"]) == 9
+    # The alpha the prune used travels with its provenance: an
+    # explicit arg here, so the probe never ran.
+    assert doc["alpha_bytes"] == float(1 << 20)
+    assert doc["alpha_source"] == "arg"
     # A non-tune JSON is refused, loudly.
     other = tmp_path / "not_tune.json"
     other.write_text(json.dumps({"kind": "gang"}))
@@ -454,8 +461,13 @@ def test_mesh_auto_end_to_end(tmp_path):
     step = make_sharded_train_step(
         module.apply, spec.loss_fn(), spec.make_optimizer(),
         mesh="auto", spec=spec, sample_batch=batch,
+        # Pinned alpha: THIS test asserts the predicted ranking
+        # ("dp8 cheapest"), and a measured per-rig alpha must not
+        # decide a deterministic assertion. The probe path has its
+        # own tests below.
         tune_kwargs={"measure_top_k": 1, "steps": 2, "repeats": 2,
-                     "artifact_path": artifact},
+                     "artifact_path": artifact,
+                     "alpha_bytes": 1 << 20},
     )
     # The auto path hands back the search and the initialized state.
     assert step.tune_result is not None and step.state is not None
@@ -477,3 +489,50 @@ def test_mesh_auto_end_to_end(tmp_path):
     with pytest.raises(ValueError, match="Mesh or 'auto'"):
         make_sharded_train_step(module.apply, spec.loss_fn(),
                                 spec.make_optimizer(), mesh="bogus")
+
+
+# ---------------------------------------------------------------------------
+# Alpha micro-probe calibration (ROADMAP item-4 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def test_calibrate_alpha_probe_measures_and_caches():
+    import jax
+
+    from sparktorch_tpu.parallel import tune as tune_mod
+
+    tune_mod._ALPHA_PROBE_CACHE.clear()
+    alpha = calibrate_alpha_bytes(jax.devices(), repeats=3)
+    # Grounded, positive, and inside the sanity clamp.
+    assert (1 << 14) <= alpha <= (1 << 24)
+    # Cached per (backend, world): the second call is free and exact.
+    assert calibrate_alpha_bytes(jax.devices(), repeats=3) == alpha
+    assert len(tune_mod._ALPHA_PROBE_CACHE) == 1
+
+
+def test_calibrate_alpha_refuses_single_device():
+    import jax
+
+    with pytest.raises(ValueError, match=">= 2 devices"):
+        calibrate_alpha_bytes(jax.devices()[:1])
+
+
+def test_resolve_alpha_priority_env_probe_default(monkeypatch):
+    from sparktorch_tpu.parallel import tune as tune_mod
+
+    # env wins over everything.
+    monkeypatch.setenv(ALPHA_ENV, "424242")
+    value, source = resolve_alpha_bytes()
+    assert (value, source) == (424242.0, "env")
+    # A garbled env falls through to the probe (cached from the test
+    # above, or measured here).
+    monkeypatch.setenv(ALPHA_ENV, "not-a-number")
+    value, source = resolve_alpha_bytes()
+    assert source == "probe" and value > 0
+    # Probe failure degrades to the backend table, never raises.
+    monkeypatch.delenv(ALPHA_ENV)
+    monkeypatch.setattr(tune_mod, "calibrate_alpha_bytes",
+                        lambda devices=None: (_ for _ in ()).throw(
+                            RuntimeError("rig on fire")))
+    value, source = resolve_alpha_bytes()
+    assert source == "default" and value > 0
