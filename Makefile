@@ -6,7 +6,8 @@ PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
-	bench-ps-fleet bench-tune bench-rpc-trace cluster-up clean lint-obs
+	bench-ps-fleet bench-tune bench-rpc-trace bench-serve cluster-up \
+	clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -184,6 +185,20 @@ bench-gang-obs:
 # otherwise. Runs on any backend (JAX_PLATFORMS=cpu works).
 bench-rpc-trace:
 	$(PYTHON) -m sparktorch_tpu.bench --config rpc_trace
+
+# Online-serving gate: under seeded Poisson open-loop load, the
+# continuous-batching inference tier must beat a serially-dispatched
+# fixed-window BatchPredictor on throughput at equal-or-better p99
+# (zero failed requests both sides); a seeded replica kill mid-load
+# must drop ZERO requests with the eviction -> restart -> re-admission
+# pipeline observed in counters; and a mid-load weight push must land
+# on every replica within the staleness bound with exact served
+# parameters — FAILS otherwise. The serve modules are covered by
+# lint-obs like everything else under sparktorch_tpu/ (no raw prints,
+# tracer-helper-only span minting, sanctioned scrape readers). Runs on
+# any backend (JAX_PLATFORMS=cpu works).
+bench-serve:
+	$(PYTHON) -m sparktorch_tpu.bench --config serve_online
 
 # Parameter-server fleet gate: under a sparse-update worker swarm, a
 # 4-shard fleet must beat the single server on aggregate pull

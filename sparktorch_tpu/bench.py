@@ -1344,6 +1344,423 @@ def bench_rpc_trace() -> dict:
     }
 
 
+def bench_serve_online() -> dict:
+    """Online serving gate (``make bench-serve``): the continuous-
+    batching tier must actually beat the fixed-window tool where it
+    claims to, and survive the faults it claims to — FAILS (raises)
+    otherwise.
+
+    Workload: Poisson open-loop single-row requests (seeded
+    exponential interarrivals at ~2x the measured serial capacity, so
+    a one-at-a-time server is genuinely overloaded — open loop:
+    arrivals never wait for completions, like real users). The load
+    threads are all PRE-SPAWNED and sleep to their own arrival times:
+    spawning threads on the clock makes the generator the bottleneck
+    and voids the comparison (measured: it halves the fast side's
+    apparent throughput). The model is sized so single-row COMPUTE
+    (~5ms) dominates per-request Python overhead — on a tiny model
+    both legs converge on the GIL and the batching win is invisible.
+    Legs run interleaved x2 and gate on MEDIANS (cpu-share rig noise
+    hits both sides).
+
+    Gates:
+    - throughput at equal-or-better p99: the continuous-batching
+      replica (admission queue -> coalesced bucket batches) must beat
+      a serially-dispatched :class:`BatchPredictor` (the fixed-window
+      tool — no admission, no coalescing) on completed rows/sec AND
+      p99 request latency under the SAME arrival schedule, with zero
+      failed requests on either side;
+    - a seeded replica kill (``ft.chaos`` ``serve.replica`` site)
+      mid-load drops ZERO requests: the router evicts the victim,
+      re-routes its in-flight admissions, the tier monitor restarts
+      it, and the router re-admits it — all observed in counters;
+    - a mid-load weight push lands on EVERY replica within the
+      staleness bound (20 poll intervals + 1s slack), and the served
+      parameters equal the server's exactly after the swap;
+    - drift: continuous throughput within tolerance of the newest
+      prior ``serve_online`` record (``SPARKTORCH_TPU_SERVE_DRIFT_TOL``,
+      default 0.5 relative — this rig's scheduler swings are real);
+      skips cleanly with no prior record.
+    """
+    import os
+    import threading
+
+    import jax
+
+    from sparktorch_tpu import serialize_torch_obj
+    from sparktorch_tpu.ft import ChaosConfig, inject
+    from sparktorch_tpu.ft.policy import FtPolicy, RestartPolicy
+    from sparktorch_tpu.inference import BatchPredictor
+    from sparktorch_tpu.models import ClassificationNet
+    from sparktorch_tpu.net.transport import BinaryTransport
+    from sparktorch_tpu.obs import Telemetry, get_telemetry
+    from sparktorch_tpu.serve.infer import InferenceReplica
+    from sparktorch_tpu.serve.param_server import (
+        ParameterServer,
+        ParamServerHttp,
+    )
+    from sparktorch_tpu.serve.router import InferenceTier, Router
+
+    from sparktorch_tpu.models import MLP
+
+    tele = get_telemetry()
+    n_requests, overload = 300, 2.0
+    rng = np.random.default_rng(0)
+
+    with tele.span("bench/init") as _sp_init:
+        # Throughput legs: an MLP big enough that one row costs real
+        # compute (~5ms serial on this rig; batch-32 runs ~6x the
+        # rows/sec of serial dispatch — the amortization continuous
+        # batching exists to capture).
+        module = MLP(features=[2048, 2048, 1024, 10])
+        xpool = rng.normal(0, 1, (512, 512)).astype(np.float32)
+        variables = module.init(jax.random.key(0), xpool[:1])
+        params = variables["params"]
+        # Fault/weight legs: the small classifier the param server
+        # trains (recovery and staleness don't need the big model).
+        clf_module = ClassificationNet(n_classes=2)
+        xsmall = rng.normal(0, 1, (64, 10)).astype(np.float32)
+
+    with tele.span("bench/compile_warmup") as _sp_warm:
+        # Calibrate the SERIAL service time (the fixed-window tool's
+        # capacity) on warmed compiles, then pick the arrival rate to
+        # overload it: the gate must compare the designs under load,
+        # not two idle servers.
+        bp = BatchPredictor(module, params, chunk=32,
+                            telemetry=Telemetry(run_id="serve_base"))
+        bp.predict(xpool[:1])
+        svc = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            bp.predict(xpool[:1])
+            svc.append(time.perf_counter() - t0)
+        svc_s = float(np.median(svc))
+        interarrival_s = svc_s / overload
+        arrivals = np.cumsum(rng.exponential(interarrival_s, n_requests))
+
+    def _poisson_leg(submit_fn, pool) -> dict:
+        """Open-loop load: every request thread is PRE-SPAWNED, waits
+        for the start gun, sleeps to its own scheduled arrival, fires,
+        and records its own completion latency (arrivals never wait
+        for completions). Failures are collected, never swallowed —
+        the zero-drop gates read them."""
+        lats: List[Optional[float]] = [None] * n_requests
+        errors: list = []
+        start = threading.Event()
+        t_ref = [0.0]
+
+        def _fire(i: int) -> None:
+            start.wait()
+            delay = arrivals[i] - (time.perf_counter() - t_ref[0])
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                out = submit_fn(pool[i % len(pool)][None, :])
+                assert out.shape[0] == 1
+                lats[i] = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 - gate counts these
+                errors.append((i, f"{type(e).__name__}: {e}"))
+
+        threads = [threading.Thread(target=_fire, args=(i,), daemon=True)
+                   for i in range(n_requests)]
+        for th in threads:
+            th.start()
+        time.sleep(0.05)  # let every thread park on the gun
+        t_ref[0] = time.perf_counter()
+        start.set()
+        for th in threads:
+            th.join(timeout=120)
+        wall = time.perf_counter() - t_ref[0]
+        done = [l for l in lats if l is not None]
+        return {
+            "wall_s": wall,
+            "completed": len(done),
+            "errors": len(errors),
+            "error_samples": [e for _, e in errors[:3]],
+            "rows_per_s": len(done) / max(wall, 1e-9),
+            "p50_ms": float(np.percentile(done, 50)) * 1e3 if done else -1,
+            "p99_ms": float(np.percentile(done, 99)) * 1e3 if done else -1,
+        }
+
+    def _baseline_leg() -> dict:
+        # The fixed-window tool behind a serial dispatch: one
+        # compiled predict per request, one at a time — exactly what
+        # BatchPredictor gives an online caller (no admission queue,
+        # no coalescing; concurrent callers serialize on the device
+        # dispatch anyway, the lock just keeps the accounting honest).
+        lock = threading.Lock()
+
+        def submit(x):
+            with lock:
+                return bp.predict(x)
+
+        return _poisson_leg(submit, xpool)
+
+    def _continuous_leg() -> dict:
+        # SAME hardware, same arrival schedule, ONE replica: the
+        # throughput win must come from admission/coalescing, not
+        # from extra compute.
+        leg_tele = Telemetry(run_id="serve_cont")
+        replica = InferenceReplica(module, params, replica_id="0",
+                                   telemetry=leg_tele,
+                                   buckets=(1, 8, 32),
+                                   max_queue_rows=1024,
+                                   warm_input=xpool[:1])
+        router = Router(telemetry=leg_tele)
+        router.register(replica)
+        try:
+            out = _poisson_leg(
+                lambda x: router.submit(x, deadline_s=120.0), xpool)
+            out["batches"] = leg_tele.counter_value(
+                "serve.batches_total", {"replica": "0"})
+            fill = leg_tele.histogram("serve.batch_fill",
+                                      {"replica": "0"})
+            out["batch_fill_p50"] = fill.get("p50")
+            out["queue_depth_p99"] = leg_tele.histogram(
+                "serve.queue_depth", {"replica": "0"}).get("p99")
+            return out
+        finally:
+            router.stop()
+            replica.stop()
+
+    with tele.span("bench/measure") as _sp_measure:
+        bases, conts = [], []
+        for _ in range(2):  # interleaved: rig noise hits both legs
+            bases.append(_baseline_leg())
+            conts.append(_continuous_leg())
+
+    def _median(legs, key):
+        vals = [leg[key] for leg in legs if leg.get(key) is not None]
+        return float(np.median(vals)) if vals else None
+
+    base = {k: (round(_median(bases, k), 3)
+                if isinstance(bases[0][k], (int, float)) else bases[0][k])
+            for k in bases[0]}
+    cont = {k: (round(_median(conts, k), 3)
+                if isinstance(conts[0][k], (int, float)) else conts[0][k])
+            for k in conts[0]}
+    throughput_ratio = cont["rows_per_s"] / max(base["rows_per_s"], 1e-9)
+    p99_ratio = cont["p99_ms"] / max(base["p99_ms"], 1e-9)
+
+    # -- seeded replica kill under load --------------------------------
+    with tele.span("bench/replica_kill") as _sp_kill:
+        kill_tele = Telemetry(run_id="serve_kill")
+        policy = FtPolicy(restart=RestartPolicy(backoff_base_s=0.02,
+                                                backoff_max_s=0.1,
+                                                max_restarts=3))
+        clf_variables = clf_module.init(jax.random.key(0), xsmall[:1])
+        tier = InferenceTier(clf_module, clf_variables["params"],
+                             n_replicas=2,
+                             telemetry=kill_tele, ft_policy=policy,
+                             buckets=(1, 8, 32), max_queue_rows=1024,
+                             warm_input=xsmall[:1],
+                             probe_interval_s=0.05)
+        # Deterministic victim: replica 0 carries a fat observed
+        # latency so the weighted pick opens on replica 1, whose 8th
+        # admission is the seeded kill.
+        kill_tele.observe("serve.request_latency_s", 0.5,
+                          labels={"replica": "0"})
+        try:
+            with inject(ChaosConfig(kill_replica_at={1: 8}),
+                        telemetry=kill_tele) as inj:
+                kill_leg = _poisson_leg(
+                    lambda x: tier.submit(x, deadline_s=60.0), xsmall)
+            kills = len([e for e in inj.events
+                         if e["site"] == "serve.replica"])
+            deadline = time.monotonic() + 15.0
+            while (kill_tele.counter_value("router.readmissions_total",
+                                           {"replica": "1"}) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            evictions = kill_tele.counter_value(
+                "router.evictions_total",
+                {"replica": "1", "reason": "error"})
+            restarts = kill_tele.counter_value(
+                "serve.replica_restarts_total", {"replica": "1"})
+            readmissions = kill_tele.counter_value(
+                "router.readmissions_total", {"replica": "1"})
+        finally:
+            tier.stop()
+
+    # -- mid-load weight push: bounded staleness + exactness -----------
+    with tele.span("bench/weight_push") as _sp_push:
+        poll_s = 0.05
+        staleness_bound_s = 20 * poll_s + 1.0
+        clf = serialize_torch_obj(
+            ClassificationNet(n_classes=2), criterion="cross_entropy",
+            optimizer="sgd", optimizer_params={"lr": 0.1},
+            input_shape=(10,),
+        )
+        push_tele = Telemetry(run_id="serve_push")
+        server = ParameterServer(clf, telemetry=push_tele)
+        http = ParamServerHttp(server, port=0).start()
+        _v, params0 = server.slot.read()
+        tier = InferenceTier(clf_module, params0, n_replicas=2,
+                             telemetry=push_tele,
+                             buckets=(1, 8), max_queue_rows=1024,
+                             warm_input=xsmall[:1],
+                             probe_interval_s=0.05)
+        tier.start_pullers(
+            lambda: BinaryTransport(http.url, quant=None),
+            poll_s=poll_s)
+        stop_load = threading.Event()
+
+        def _background_load():
+            while not stop_load.is_set():
+                tier.submit(xsmall[:1], deadline_s=30.0)
+                time.sleep(0.005)
+
+        loader = threading.Thread(target=_background_load, daemon=True)
+        loader.start()
+        try:
+            time.sleep(0.3)  # pullers sync the initial version
+            grads = jax.tree.map(
+                lambda a: np.ones_like(np.asarray(a)), params0)
+            server.push_gradients(grads, wait=True)
+            pushed_version = server.slot.version
+            t_push = time.monotonic()
+            staleness: Dict[str, float] = {}
+            deadline = t_push + staleness_bound_s + 5.0
+            while (len(staleness) < len(tier.replicas)
+                   and time.monotonic() < deadline):
+                for rid, replica in tier.replicas.items():
+                    if rid not in staleness \
+                            and replica.params_version >= pushed_version:
+                        staleness[rid] = time.monotonic() - t_push
+                time.sleep(0.01)
+            stop_load.set()
+            loader.join(timeout=30)
+            # Exactness: the SERVED parameters equal the pushed ones.
+            _v2, server_params = server.slot.read()
+            ref = np.asarray(clf_module.apply(
+                {"params": server_params}, xsmall[:8]))
+            push_exact = True
+            for replica in tier.replicas.values():
+                out = replica.infer(xsmall[:8])
+                if not np.allclose(out, ref, rtol=1e-5, atol=1e-6):
+                    push_exact = False
+        finally:
+            stop_load.set()
+            tier.stop()
+            http.stop()
+            server.stop()
+
+    # -- the gates ------------------------------------------------------
+    if base["errors"] or cont["errors"]:
+        raise AssertionError(
+            f"load legs dropped requests: baseline {base['errors']} "
+            f"({base['error_samples']}), continuous {cont['errors']} "
+            f"({cont['error_samples']})"
+        )
+    # Completion counted SEPARATELY from errors: a future that is
+    # never resolved raises nothing — its load thread just times out
+    # — and an errors-only gate would report that orphaned request as
+    # success.
+    for leg_name, leg in (("baseline", base), ("continuous", cont),
+                          ("replica_kill", kill_leg)):
+        if leg["completed"] != n_requests:
+            raise AssertionError(
+                f"{leg_name} leg completed only {leg['completed']}/"
+                f"{n_requests} requests with no error raised — "
+                f"orphaned futures are silent drops"
+            )
+    if not throughput_ratio > 1.0:
+        raise AssertionError(
+            f"continuous batching did not beat the fixed-window "
+            f"BatchPredictor on throughput: {cont['rows_per_s']:.0f} "
+            f"vs {base['rows_per_s']:.0f} rows/s "
+            f"(x{throughput_ratio:.2f})"
+        )
+    if not p99_ratio <= 1.0:
+        raise AssertionError(
+            f"continuous batching p99 regressed vs the fixed-window "
+            f"baseline: {cont['p99_ms']:.1f} vs {base['p99_ms']:.1f} "
+            f"ms (x{p99_ratio:.2f}) — the throughput win must not be "
+            f"bought with latency"
+        )
+    if kill_leg["errors"]:
+        raise AssertionError(
+            f"replica-kill leg DROPPED {kill_leg['errors']} requests "
+            f"({kill_leg['error_samples']}) — the router must re-route "
+            f"every admission of the killed replica"
+        )
+    if kills < 1:
+        raise AssertionError("seeded replica kill never fired")
+    if evictions < 1 or restarts < 1 or readmissions < 1:
+        raise AssertionError(
+            f"recovery pipeline incomplete: evictions={evictions} "
+            f"restarts={restarts} readmissions={readmissions}"
+        )
+    if len(staleness) < 2:
+        raise AssertionError(
+            f"mid-load weight push reached only {len(staleness)}/2 "
+            f"replicas within {staleness_bound_s + 5.0:.1f}s"
+        )
+    max_staleness = max(staleness.values())
+    if max_staleness > staleness_bound_s:
+        raise AssertionError(
+            f"weight-update staleness {max_staleness:.2f}s exceeds "
+            f"the {staleness_bound_s:.2f}s bound"
+        )
+    if not push_exact:
+        raise AssertionError(
+            "served parameters != pushed parameters after the swap"
+        )
+
+    # -- drift gate (arms once a prior record is retained) -------------
+    tol = float(os.environ.get("SPARKTORCH_TPU_SERVE_DRIFT_TOL", "0.5"))
+    prior = _prior_record("serve_online", "cont_rows_per_s")
+    if prior is None:
+        drift = {"status": "no_prior_record", "tolerance": tol}
+    else:
+        prior_rate = float(prior["cont_rows_per_s"])
+        drift = {
+            "status": "checked", "tolerance": tol,
+            "prior_ts": prior.get("ts"),
+            "prior_cont_rows_per_s": round(prior_rate, 1),
+            "rows_per_s_ratio": round(
+                cont["rows_per_s"] / max(prior_rate, 1e-9), 3),
+        }
+        if cont["rows_per_s"] < prior_rate * (1.0 - tol):
+            raise AssertionError(
+                f"serve_online throughput regressed: "
+                f"{cont['rows_per_s']:.0f} vs prior "
+                f"{prior_rate:.0f} rows/s (past the {tol} relative "
+                f"tolerance); drift: {drift}"
+            )
+
+    return {
+        "config": "serve_online", "unit": "x (throughput ratio)",
+        "value": round(throughput_ratio, 3),
+        "n_requests": n_requests,
+        "serial_service_ms": round(svc_s * 1e3, 3),
+        "offered_rate_rps": round(1.0 / interarrival_s, 1),
+        "throughput_ratio": round(throughput_ratio, 3),
+        "p99_ratio": round(p99_ratio, 3),
+        "cont_rows_per_s": cont["rows_per_s"],
+        "baseline": base, "continuous": cont,
+        "replica_kill": {**kill_leg, "kills": kills,
+                         "evictions": evictions, "restarts": restarts,
+                         "readmissions": readmissions},
+        "weight_push": {
+            "poll_s": poll_s,
+            "staleness_s": {k: round(v, 3)
+                            for k, v in sorted(staleness.items())},
+            "staleness_bound_s": staleness_bound_s,
+            "exact": push_exact,
+        },
+        "serve_drift": drift,
+        "phase_s": {
+            "init": round(_sp_init.duration_s, 3),
+            "compile_warmup": round(_sp_warm.duration_s, 3),
+            "measure": round(_sp_measure.duration_s, 3),
+            "replica_kill": round(_sp_kill.duration_s, 3),
+            "weight_push": round(_sp_push.duration_s, 3),
+        },
+    }
+
+
 def _prior_record(config: str, field: str,
                   root: Optional[str] = None,
                   mesh: Optional[str] = None) -> Optional[dict]:
@@ -2714,6 +3131,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_chaos": bench_hogwild_chaos,
     "hogwild_chaos_soak": bench_hogwild_chaos_soak,
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
+    "serve_online": bench_serve_online,
     "rpc_trace": bench_rpc_trace,
     "sharded_trace": bench_sharded_trace,
     "gang_obs": bench_gang_obs,

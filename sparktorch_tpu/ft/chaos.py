@@ -83,6 +83,20 @@ class ChaosConfig:
     # hop named as the critical path, not inferred from aggregates).
     slow_shard_s: Mapping[Any, float] = dataclasses.field(
         default_factory=dict)
+    # replica id -> Nth admitted request (1-based) at which that
+    # SERVING replica dies mid-admission (one-shot, so a monitor-
+    # restarted replica survives its rerun) — the router-eviction
+    # fault, mirroring kill_shard_at: the router must fail the hop,
+    # evict, and re-route the request with zero drops.
+    kill_replica_at: Mapping[Any, int] = dataclasses.field(
+        default_factory=dict)
+    # replica id -> seconds of injected latency on every request that
+    # replica admits while the config is installed — the straggler-
+    # replica fault (correct, just slow): load-aware routing must
+    # shift traffic away, and a traced request's replica hop must
+    # name it.
+    slow_replica_s: Mapping[Any, float] = dataclasses.field(
+        default_factory=dict)
 
 
 class ChaosInjector:
@@ -105,6 +119,8 @@ class ChaosInjector:
         self._truncs_left = int(config.truncate_pull_frames)
         self._shard_requests: Dict[str, int] = {}
         self._shard_kills_fired: set = set()
+        self._replica_requests: Dict[str, int] = {}
+        self._replica_kills_fired: set = set()
 
     def _record(self, site: str, **ctx: Any) -> None:
         self.events.append({"site": site, **ctx})
@@ -185,6 +201,31 @@ class ChaosInjector:
                         self._shard_kills_fired.add(shard)
                         self._record(site, shard=shard,
                                      route=ctx.get("route"))
+                        action["die"] = True
+            return action or None
+        elif site == "serve.replica":
+            # Same shape as 'fleet.shard': an optional straggler delay
+            # plus a one-shot Nth-request kill, keyed by replica id.
+            replica = str(ctx.get("replica"))
+            action = {}
+            delay = next((float(v) for k, v in cfg.slow_replica_s.items()
+                          if str(k) == replica), None)
+            if delay:
+                with self._lock:
+                    self._record(site, replica=replica, delay_s=delay)
+                action["delay"] = delay
+            at = next((int(v) for k, v in cfg.kill_replica_at.items()
+                       if str(k) == replica), None)
+            if at is not None:
+                with self._lock:
+                    count = self._replica_requests.get(replica, 0) + 1
+                    self._replica_requests[replica] = count
+                    if count >= at \
+                            and replica not in self._replica_kills_fired:
+                        # One-shot per replica: the monitor-restarted
+                        # replica's requests survive their rerun.
+                        self._replica_kills_fired.add(replica)
+                        self._record(site, replica=replica)
                         action["die"] = True
             return action or None
         return None

@@ -43,6 +43,7 @@ from sparktorch_tpu.obs.collector import (
     run_tag,
     scrape_json,
     scrape_text,
+    snapshot_histogram,
 )
 from sparktorch_tpu.obs.rpctrace import (
     RpcTracer,
@@ -83,6 +84,7 @@ __all__ = [
     "run_tag",
     "scrape_json",
     "scrape_text",
+    "snapshot_histogram",
     "RpcTracer",
     "SpanContext",
     "critical_path",

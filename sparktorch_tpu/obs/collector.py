@@ -121,6 +121,41 @@ def scrape_json(url: str, timeout: float = _SCRAPE_TIMEOUT) -> Any:
         raise ScrapeError(f"{url}: torn/invalid JSON: {e}") from e
 
 
+def snapshot_histogram(snapshot: Mapping[str, Any], name: str,
+                       labels: Optional[Mapping[str, Any]] = None
+                       ) -> Optional[Dict[str, Any]]:
+    """The roll-up of histogram ``name`` in a telemetry snapshot dict
+    (a ``/telemetry`` scrape, a collector's merged snapshot, or a
+    JSONL dump record), matched by name + a label SUBSET: every given
+    label must match, EXTRA labels on the series are ignored — a
+    collector re-keys scraped series with rank/host labels, and a
+    consumer asking for ``serve.request_latency_s{replica=2}`` must
+    find it regardless of which target it was scraped from. When
+    several series match (the same replica scraped under two targets)
+    the one with the largest sample count wins. None when nothing
+    matches — readers must treat that as "no signal", never as zero.
+
+    This is the sanctioned read path for routing/consuming decisions
+    off scraped snapshots (the lint-obs scrape discipline's read-side
+    twin): the ``name{k=v}`` key grammar stays parsed in obs/."""
+    hists = snapshot.get("histograms")
+    if not isinstance(hists, Mapping):
+        return None
+    want = {str(k): str(v) for k, v in (labels or {}).items()}
+    best: Optional[Dict[str, Any]] = None
+    for flat, rollup in hists.items():
+        series_name, series_labels = _parse_flat_key(str(flat))
+        if series_name != name or not isinstance(rollup, Mapping):
+            continue
+        have = dict(series_labels)
+        if any(have.get(k) != v for k, v in want.items()):
+            continue
+        if best is None or (rollup.get("count") or 0) > \
+                (best.get("count") or 0):
+            best = dict(rollup)
+    return best
+
+
 # ---------------------------------------------------------------------------
 # The collector
 # ---------------------------------------------------------------------------

@@ -231,6 +231,14 @@ DEFAULT_ALPHA_BYTES = {"cpu": 1 << 20, "gpu": 1 << 18, "tpu": 1 << 17}
 # the ROADMAP's alpha-calibration follow-up promised to keep).
 ALPHA_ENV = "SPARKTORCH_TPU_TUNE_ALPHA_BYTES"
 
+# Tune-result cache knob: "0" disables, a path overrides the default
+# cache directory (~/.cache/sparktorch_tpu/tune). The cache is keyed
+# by a (workload dims, global batch, device fingerprint, search
+# space) hash, so a ``mesh="auto"`` RE-RUN of the same workload on
+# the same rig loads the cached winner instead of re-searching (and
+# re-compiling every candidate).
+TUNE_CACHE_ENV = "SPARKTORCH_TPU_TUNE_CACHE"
+
 # One probe per (backend, device-count) per process: the measurement
 # costs two tiny compiles (~1-2s on the CPU rig), and every
 # mesh="auto" call in a session shares the same rig.
@@ -508,6 +516,8 @@ class TuneResult:
     run_id: Optional[str] = None
     alpha_bytes: float = 0.0     # the per-launch alpha the prune used
     alpha_source: str = "default"  # arg | env | probe | default
+    cache_hit: bool = False      # loaded from the tune-result cache
+    cache_key: Optional[str] = None  # (workload, rig) fingerprint hash
 
     def best_config(self) -> MeshConfig:
         sizes = {a: int(self.best.get(a, 1)) for a in ALL_AXES}
@@ -552,6 +562,8 @@ class TuneResult:
             "exposed_weight": self.exposed_weight,
             "alpha_bytes": self.alpha_bytes,
             "alpha_source": self.alpha_source,
+            "cache_hit": self.cache_hit,
+            "cache_key": self.cache_key,
             "caps": {k: list(v) for k, v in self.caps.items()},
             "n_candidates": len(self.candidates),
             "n_measured": sum(c.status == STATUS_MEASURED
@@ -588,6 +600,8 @@ class TuneResult:
             run_id=d.get("run_id"),
             alpha_bytes=float(d.get("alpha_bytes", 0.0)),
             alpha_source=str(d.get("alpha_source", "default")),
+            cache_hit=bool(d.get("cache_hit", False)),
+            cache_key=d.get("cache_key"),
         )
 
     def save(self, path: str) -> str:
@@ -847,6 +861,126 @@ def workload_for(spec, batch, seq_len: Optional[int] = None
                          global_batch=global_batch), None
 
 
+# ---------------------------------------------------------------------------
+# Tune-result cache (ROADMAP item-4 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def device_fingerprint(devices: Sequence[Any]) -> Dict[str, Any]:
+    """What makes this rig THIS rig for mesh selection: backend,
+    device kinds, and count. Deliberately excludes the calibrated
+    alpha (a measurement input that jitters run to run — two runs on
+    the same hardware must share a cache entry)."""
+    kinds = sorted({str(getattr(d, "device_kind", "?")) for d in devices})
+    platforms = sorted({str(getattr(d, "platform", "?")) for d in devices})
+    return {"n_devices": len(devices), "platforms": platforms,
+            "kinds": kinds}
+
+
+def _tx_cache_tag(tx) -> Optional[str]:
+    """Coarse deterministic optimizer fingerprint for the tune-result
+    cache: the STRUCTURE of its init state on a probe param (adam's
+    moment leaves vs sgd's empty state — the state tree is what fsdp
+    shards and the measured step applies). Hyperparameters like the
+    learning rate don't change which mesh wins and deliberately don't
+    key; optax transforms carry no stable repr, so structure is the
+    only deterministic handle."""
+    if tx is None:
+        return None
+    try:
+        import jax as _jax
+
+        state = tx.init({"w": np.zeros((1,), np.float32)})
+        leaves, treedef = _jax.tree_util.tree_flatten(state)
+        dtypes = [str(getattr(leaf, "dtype", type(leaf).__name__))
+                  for leaf in leaves]
+        return f"{treedef}:{dtypes}"
+    except Exception:  # noqa: BLE001 - an exotic tx degrades, not dies
+        return type(tx).__name__
+
+
+def tune_cache_key(shape: WorkloadShape, caps: Mapping[str, Sequence[int]],
+                   axes: Sequence[str], devices: Sequence[Any],
+                   seq_sharded: bool, measure_top_k: int,
+                   exposed_weight: float, *, max_candidates: int = 64,
+                   steps: int = 4, repeats: int = 3,
+                   min_rounds: int = 2, noise_mult: float = 2.0,
+                   tx_tag: Optional[str] = None,
+                   alpha_override: Optional[str] = None) -> str:
+    """Deterministic hash of everything that decides WHICH mesh wins:
+    the workload's dims (model shape + global batch), the rig
+    fingerprint, and the search space/scoring/measurement knobs
+    (``max_candidates`` can TRUNCATE the candidate list — an entry
+    searched under a tighter cap must not satisfy a wider re-run;
+    the round/step knobs decide measurement fidelity; ``tx_tag``
+    distinguishes optimizers by state structure; ``alpha_override``
+    keys an EXPLICIT alpha — kwarg or env — which deterministically
+    changes the prune ranking, while the probe-measured alpha stays
+    excluded because it jitters). Two calls with the same key would
+    re-run the identical search — which is exactly what the cache
+    skips."""
+    import hashlib
+
+    doc = {
+        # Bump when the cost model, scoring, or enumeration changes
+        # behavior: an on-disk entry searched by obsolete logic must
+        # not satisfy the new version's key.
+        "schema": 1,
+        "shape": dataclasses.asdict(shape),
+        "caps": {k: sorted(int(x) for x in v) for k, v in caps.items()},
+        "axes": list(axes),
+        "device": device_fingerprint(devices),
+        "seq_sharded": bool(seq_sharded),
+        "measure_top_k": int(measure_top_k),
+        "exposed_weight": float(exposed_weight),
+        "max_candidates": int(max_candidates),
+        "measure": [int(steps), int(repeats), int(min_rounds),
+                    float(noise_mult)],
+        "tx": tx_tag,
+        "alpha_override": alpha_override,
+    }
+    blob = json.dumps(doc, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
+def _tune_cache_dir() -> Optional[str]:
+    """The cache directory, or None when disabled
+    (``SPARKTORCH_TPU_TUNE_CACHE=0``). A non-flag env value is a
+    directory override."""
+    env = os.environ.get(TUNE_CACHE_ENV)
+    if env is not None:
+        env = env.strip()
+        if env in ("0", "false", "off"):
+            return None
+        if env not in ("", "1", "true", "on"):
+            return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "sparktorch_tpu", "tune")
+
+
+def _cache_load(key: str) -> Optional[TuneResult]:
+    cache_dir = _tune_cache_dir()
+    if cache_dir is None:
+        return None
+    path = os.path.join(cache_dir, f"tune_{key}.json")
+    try:
+        result = TuneResult.load(path)
+    except (OSError, ValueError, KeyError):
+        return None  # absent or torn: a cache never fails a search
+    return result
+
+
+def _cache_store(key: str, result: TuneResult) -> None:
+    cache_dir = _tune_cache_dir()
+    if cache_dir is None:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        result.save(os.path.join(cache_dir, f"tune_{key}.json"))
+    except OSError:
+        pass  # read-only home: the search result still returns
+
+
 def autotune(
     spec,
     batch,
@@ -869,6 +1003,7 @@ def autotune(
     artifact_path: Optional[str] = None,
     telemetry=None,
     measure_fn: Optional[Callable] = None,
+    cache: bool = False,
 ) -> TuneResult:
     """Search mesh configs for ``spec`` on ``batch``; return the
     :class:`TuneResult` whose ``best_config()`` is the chosen mesh.
@@ -889,7 +1024,11 @@ def autotune(
     the early stop — every legal candidate is measured for all
     rounds (the ``make bench-tune`` referee mode). ``measure_fn``
     (same signature as :func:`prepare_candidate`) lets tests pin the
-    decision logic without a backend."""
+    decision logic without a backend. ``cache=True`` keys the result
+    by a (workload dims, rig fingerprint, search space) hash and
+    loads a prior run's winner instead of re-searching (artifact
+    records ``cache_hit``; ``SPARKTORCH_TPU_TUNE_CACHE=0`` opts out,
+    a path value relocates the cache directory)."""
     t_start = time.perf_counter()
     if devices is None:
         import jax
@@ -910,6 +1049,43 @@ def autotune(
     caps = dict(caps)
     if not seq_sharded:
         caps["sp"] = (1,)
+
+    # Tune-result cache: a re-run of the same (workload dims, rig
+    # fingerprint, search space) loads the cached winner instead of
+    # re-searching — checked BEFORE the alpha probe, which is itself
+    # seconds of compile. Only real searches participate: a scripted
+    # measure_fn (tests) or exhaustive referee run must never be
+    # satisfied — or poisoned — by a cache entry, and
+    # SPARKTORCH_TPU_TUNE_CACHE=0 kills it globally.
+    cache_key: Optional[str] = None
+    use_cache = (cache and measure_fn is None and not exhaustive
+                 and _tune_cache_dir() is not None)
+    if use_cache:
+        cache_key = tune_cache_key(shape, caps, axes, devices,
+                                   seq_sharded, measure_top_k,
+                                   exposed_weight,
+                                   max_candidates=max_candidates,
+                                   steps=steps, repeats=repeats,
+                                   min_rounds=min_rounds,
+                                   noise_mult=noise_mult,
+                                   tx_tag=_tx_cache_tag(tx),
+                                   alpha_override=(
+                                       str(alpha_bytes)
+                                       if alpha_bytes is not None
+                                       else os.environ.get(ALPHA_ENV)))
+        cached = _cache_load(cache_key)
+        if cached is not None:
+            cached.cache_hit = True
+            cached.cache_key = cache_key
+            cached.publish(telemetry)
+            if artifact_path:
+                cached.save(artifact_path)
+            _LOG.info(
+                f"[sparktorch_tpu:tune] cache HIT {cache_key}: "
+                f"{cached.best_label} (search skipped; "
+                f"{TUNE_CACHE_ENV}=0 to disable)"
+            )
+            return cached
 
     # Enumerate the FULL legal space — the cost model is what decides
     # what gets dropped, never enumeration order.
@@ -1071,10 +1247,13 @@ def autotune(
         run_id=getattr(telemetry, "run_id", None),
         alpha_bytes=float(alpha_bytes),
         alpha_source=alpha_source,
+        cache_key=cache_key,
     )
     result.publish(telemetry)
     if artifact_path:
         result.save(artifact_path)
+    if use_cache and cache_key is not None:
+        _cache_store(cache_key, result)
     _LOG.info(
         f"[sparktorch_tpu:tune] chose {result.best_label} from "
         f"{len(candidates)} candidates "

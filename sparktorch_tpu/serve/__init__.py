@@ -1,14 +1,29 @@
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
 
 __all__ = ["ParameterServer", "ParamServerHttp", "ParamServerFleet",
-           "ParamShardServer"]
+           "ParamShardServer", "InferenceReplica", "InferenceTier",
+           "Router", "WeightPuller", "Overloaded", "DeadlineExceeded",
+           "ReplicaStopped", "NoReplicasAvailable"]
+
+_INFER = ("InferenceReplica", "WeightPuller", "Overloaded",
+          "DeadlineExceeded", "ReplicaStopped")
+_ROUTER = ("InferenceTier", "Router", "NoReplicasAvailable")
 
 
 def __getattr__(name):
-    # Lazy: the fleet pulls in net.sharded + jax; keep the base import
-    # light (and cycle-free) for callers that only want one server.
+    # Lazy: the fleet and the inference tier pull in net.sharded /
+    # jax; keep the base import light (and cycle-free) for callers
+    # that only want one server.
     if name in ("ParamServerFleet", "ParamShardServer"):
         from sparktorch_tpu.serve import fleet
 
         return getattr(fleet, name)
+    if name in _INFER:
+        from sparktorch_tpu.serve import infer
+
+        return getattr(infer, name)
+    if name in _ROUTER:
+        from sparktorch_tpu.serve import router
+
+        return getattr(router, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

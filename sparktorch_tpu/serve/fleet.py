@@ -48,6 +48,7 @@ instead; pick the single server for those.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -106,6 +107,49 @@ class _LossVote:
     @property
     def should_stop(self) -> bool:
         return self._stop
+
+
+def _render_leaf_body(owner, items, version: int, quant: Optional[str],
+                      run_tag: int) -> bytes:
+    """Shared encode tail of BOTH delta renderers (shard + gateway —
+    one implementation so an EF or cache fix can't land on one path
+    and leave the other serving divergent bytes): int8 server-side
+    error feedback — each (leaf, cache_tag) quantized ONCE, the
+    residual folded into that leaf's next version — then one v2
+    frame. ``items``: (path, cache_tag, leaf_version, host_array);
+    the caller holds its render lock (the residuals and quant cache
+    are owner state)."""
+    leaves: List[Tuple[Path, Any]] = []
+    leaf_versions: Dict[Path, int] = {}
+    for path, cache_tag, lver, arr in items:
+        if quant == "int8" and binwire._is_float(arr) and arr.size:
+            qc = owner._quant_cache.get(path)
+            if qc is None or qc[0] != cache_tag:
+                qleaf, residual = binwire.quantize_leaf_int8(
+                    arr, owner._pull_residuals.get(path)
+                )
+                owner._pull_residuals[path] = residual
+                owner._quant_cache[path] = (cache_tag, qleaf)
+            else:
+                qleaf = qc[1]
+            leaves.append((path, qleaf))
+        else:
+            leaves.append((path, arr))
+        leaf_versions[path] = lver
+    return binwire.frame_bytes(binwire.encode(
+        leaves, version=version, run_tag=run_tag,
+        leaf_versions=leaf_versions,
+    ))
+
+
+def _body_cache_get(owner, key, version: int):
+    """Shared body-cache lookup with ONE eviction rule for both
+    renderers: a new version or >64 keys clears the cache. Caller
+    holds its render lock."""
+    if owner._bodies_version != version or len(owner._bodies) > 64:
+        owner._bodies.clear()
+        owner._bodies_version = version
+    return owner._bodies.get(key)
 
 
 class ParamShardServer:
@@ -345,14 +389,10 @@ class ParamShardServer:
         key = (version, quant or "",
                tuple(sorted((p, v) for p, _, v in entries)))
         with self._render_lock:
-            if self._bodies_version != version or len(self._bodies) > 64:
-                self._bodies.clear()
-                self._bodies_version = version
-            body = self._bodies.get(key)
+            body = _body_cache_get(self, key, version)
             if body is not None:
                 return version, body
-            leaves: List[Tuple[Path, Any]] = []
-            leaf_versions: Dict[Path, int] = {}
+            items = []
             for path, leaf, lver in entries:
                 cached = self._host_leaves.get(path)
                 if cached is None or cached[0] != lver:
@@ -360,24 +400,9 @@ class ParamShardServer:
                     self._host_leaves[path] = (lver, arr)
                 else:
                     arr = cached[1]
-                if quant == "int8" and binwire._is_float(arr) and arr.size:
-                    qc = self._quant_cache.get(path)
-                    if qc is None or qc[0] != lver:
-                        qleaf, residual = binwire.quantize_leaf_int8(
-                            arr, self._pull_residuals.get(path)
-                        )
-                        self._pull_residuals[path] = residual
-                        self._quant_cache[path] = (lver, qleaf)
-                    else:
-                        qleaf = qc[1]
-                    leaves.append((path, qleaf))
-                else:
-                    leaves.append((path, arr))
-                leaf_versions[path] = lver
-            body = binwire.frame_bytes(binwire.encode(
-                leaves, version=version, run_tag=run_tag,
-                leaf_versions=leaf_versions,
-            ))
+                items.append((path, lver, lver, arr))
+            body = _render_leaf_body(self, items, version, quant,
+                                     run_tag)
             self._bodies[key] = body
             self.telemetry.counter("fleet.delta_renders",
                                    labels=self._labels)
@@ -465,7 +490,11 @@ class _CompositeSlot:
 
     def __init__(self, fleet: "ParamServerFleet"):
         self._fleet = fleet
-        self.epoch = None  # gateway serves no delta route
+        # Boot nonce for the gateway's delta route (same contract as
+        # TreeVersionedSlot.epoch): a REBUILT gateway restarts its
+        # composite-version stamping, and clients detect that by epoch
+        # change, never by version arithmetic.
+        self.epoch = int.from_bytes(os.urandom(8), "little") >> 1
 
     def read(self) -> Tuple[int, Any]:
         # Under the topology lock: mid-drain, the offset and the shard
@@ -494,12 +523,107 @@ class _CompositeSlot:
 class _GatewayFacade:
     """Duck-types the :class:`ParameterServer` surface
     :class:`ParamServerHttp` serves, backed by the whole fleet:
-    pulls assemble, pushes scatter by ring ownership."""
+    pulls assemble, pushes scatter by ring ownership — and
+    ``render_delta`` assembles the per-shard v2 DELTA state into one
+    frame, so legacy-topology clients (and serving replicas pointed at
+    a gateway) get the per-tensor delta byte win without speaking the
+    ring."""
 
     def __init__(self, fleet: "ParamServerFleet"):
         self._fleet = fleet
         self.slot = _CompositeSlot(fleet)
         self.telemetry = fleet.telemetry
+        # Delta assembly state (all under _render_lock). Per-shard
+        # leaf versions are NOT comparable across shards (independent
+        # counters), so the gateway re-stamps every observed
+        # (shard, leaf_version) change with the COMPOSITE version
+        # current at observation — monotonic by construction of
+        # _CompositeSlot.version — and serves "every leaf whose
+        # composite stamp advanced past the client's have".
+        self._render_lock = threading.Lock()
+        self._stamp: Dict[Path, Tuple[str, int]] = {}
+        self._cstamp: Dict[Path, int] = {}
+        self._host_leaves: Dict[Path, Tuple[Tuple[str, int],
+                                            np.ndarray]] = {}
+        self._quant_cache: Dict[Path, Tuple[Tuple[str, int],
+                                            binwire.QuantLeaf]] = {}
+        self._pull_residuals: Dict[Path, np.ndarray] = {}
+        self._bodies: Dict[Tuple, bytes] = {}
+        self._bodies_version: Optional[int] = None
+        self._last_walk_sig: Optional[Tuple] = None
+
+    def render_delta(self, have_version: int, quant: Optional[str] = None,
+                     run_tag: int = 0) -> Tuple[int, Optional[bytes]]:
+        """``(composite_version, body)`` — one v2 delta frame of every
+        leaf (from ANY shard) whose state changed past the client's
+        composite ``have_version``; ``(version, None)`` when up to
+        date. Same int8 server-side error-feedback and shared-render
+        caching contract as :meth:`ParamShardServer.render_delta`,
+        with the gateway owning its own residuals (it serves its own
+        quantized stream). A composite version that advanced with no
+        leaf change (an empty shard drained) answers 304 — correct,
+        just conservative."""
+        if quant not in (None, "", "int8"):
+            raise ValueError(f"pull quant {quant!r}; use int8 or nothing")
+        have = int(have_version)
+        self.telemetry.counter("fleet.gateway_delta_pulls")
+        with self._fleet._topology_lock:
+            # One coherent topology read (see _CompositeSlot.read for
+            # why the lock matters mid-drain); the per-shard slot
+            # reads inside are lock-free snapshots.
+            version = self._fleet._version_offset
+            shard_reads = []
+            for shard in self._fleet._shards.values():
+                v, leaves, vers = shard.slot.read_leaves()
+                version += v
+                shard_reads.append((shard.shard_id, v, leaves, vers))
+        with self._render_lock:
+            # Steady-state fast path: N replicas each poll at 20Hz,
+            # and when no shard's slot version moved since the last
+            # render the stamps are already current — skip the
+            # O(total_leaves) restamp walk straight to the 304/cached
+            # answer. An OLDER concurrent read may regress the
+            # signature (forcing one redundant walk next poll); the
+            # per-leaf guards below keep that harmless.
+            sig = tuple(sorted((sid, v) for sid, v, _, _ in shard_reads))
+            if sig != self._last_walk_sig:
+                for sid, _v, leaves, vers in shard_reads:
+                    for path, lver in vers.items():
+                        tag = (sid, lver)
+                        # Strict version guard: two concurrent renders
+                        # serialize HERE after reading the topology at
+                        # different instants, and the older read must
+                        # not re-stamp a leaf backwards (it would 304
+                        # newer state to clients until the next real
+                        # change). Genuine changes always advance the
+                        # composite version, so older-read
+                        # observations lose.
+                        if self._stamp.get(path) != tag \
+                                and version > self._cstamp.get(path, -1):
+                            self._stamp[path] = tag
+                            self._cstamp[path] = version
+                            self._host_leaves[path] = (tag, np.asarray(
+                                leaves[path]))
+                self._last_walk_sig = sig
+            if have >= version:
+                return version, None
+            changed = [p for p, cv in self._cstamp.items() if cv > have]
+            if not changed:
+                return version, None
+            key = (version, quant or "",
+                   tuple(sorted((p, self._cstamp[p]) for p in changed)))
+            body = _body_cache_get(self, key, version)
+            if body is not None:
+                return version, body
+            items = []
+            for path in changed:
+                tag, arr = self._host_leaves[path]
+                items.append((path, tag, self._cstamp[path], arr))
+            body = _render_leaf_body(self, items, version, quant,
+                                     run_tag)
+            self._bodies[key] = body
+            self.telemetry.counter("fleet.gateway_delta_renders")
+            return version, body
 
     def push_gradients(self, grads, wait: bool = True,
                        timeout: float = 60.0, trace_ctx=None) -> None:

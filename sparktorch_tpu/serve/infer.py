@@ -1,0 +1,636 @@
+"""Continuous-batching online inference replica with live weight pulls.
+
+The reference's only serving story is a batch-1 Python UDF per
+DataFrame row (``torch_distributed.py:96-127``); this repo's
+:class:`~sparktorch_tpu.inference.BatchPredictor` compiled that into
+fixed chunks but stayed a single-host BATCH tool — a caller hands it a
+matrix and waits. Online traffic is the opposite shape: many small
+requests arriving continuously, each with its own latency budget.
+This module is the serving half the ROADMAP's "heavy traffic" north
+star was missing:
+
+- **Continuous batching** (:class:`InferenceReplica`): requests are
+  admitted into a bounded queue and coalesced into the NEXT in-flight
+  batch — no fixed windows, no timers. Batches pad up to one of a few
+  BUCKET sizes so XLA compiles once per bucket (warmed up front), and
+  padded rows are trimmed before results fan back out, so a request
+  only ever sees its own rows. Admission is where backpressure lives:
+  a full queue answers 429 (:class:`Overloaded`, counted) instead of
+  queueing unboundedly, and a request whose deadline lapses while
+  queued is expired without wasting a batch slot on it.
+- **Live weight updates** (:class:`WeightPuller`): a background thread
+  pulls fresh parameters from a parameter server — the binary wire's
+  version-tagged 304 pulls against a single server or the fleet
+  gateway's ``/delta.bin`` (only advanced leaves ship), or a
+  :class:`~sparktorch_tpu.net.sharded.ShardedTransport` against the
+  shard fleet — and atomically swaps the serving (params, state) pair
+  BETWEEN batches. A hogwild training run and its serving fleet share
+  one substrate: the same server, the same wire, the same versions.
+- **Observability**: batch fill, queue depth, request latency, and
+  batch execution land on the Telemetry bus (``serve.*``); per-rank
+  heartbeats give the router its liveness signal; sampled RPC trace
+  contexts handed down by the router get ``queue_wait`` and
+  ``execute`` child spans, so a slow request's waterfall says whether
+  it waited in admission or burned in the batch.
+
+Replicas are thread-hosted like the param-server fleet's shards (one
+process, real sockets optional) — the deployment seam for
+process-per-replica is the same as the fleet's (ROADMAP follow-up).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparktorch_tpu.ft import chaos as _chaos
+from sparktorch_tpu.net import wire as _wire
+from sparktorch_tpu.net.transport import TransportError
+from sparktorch_tpu.utils.locks import VersionedSlot
+
+DEFAULT_BUCKETS = (1, 8, 32)
+
+
+class Overloaded(RuntimeError):
+    """Admission refused: the replica's (or router's) queue is full.
+    The HTTP spelling is 429 — callers shed load or retry elsewhere."""
+
+    status = 429
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline lapsed before it reached a batch."""
+
+
+class ReplicaStopped(RuntimeError):
+    """The replica died (chaos kill, stop) with this request pending —
+    the router re-routes; a direct caller retries elsewhere."""
+
+
+class InferFuture:
+    """Completion handle for one admitted request. ``result()`` blocks
+    until the batch that carried the request lands, then returns this
+    request's rows (padding already trimmed) or raises the failure."""
+
+    __slots__ = ("_done", "_result", "_error")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def _set_result(self, value: np.ndarray) -> None:
+        self._result = value
+        self._done.set()
+
+    def _set_error(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("x", "n", "future", "deadline_t", "enq_ts", "enq_t0",
+                 "trace_ctx")
+
+    def __init__(self, x: np.ndarray, deadline_s: float, trace_ctx):
+        self.x = x
+        self.n = int(x.shape[0])
+        self.future = InferFuture()
+        self.enq_ts = time.time()
+        self.enq_t0 = time.perf_counter()
+        self.deadline_t = self.enq_t0 + float(deadline_s)
+        self.trace_ctx = trace_ctx
+
+
+class InferenceReplica:
+    """One serving replica: admission queue -> continuous batcher over
+    a compiled-per-bucket forward, with atomically swappable weights.
+
+    ``buckets`` are the padded batch sizes the forward compiles for
+    (ascending; the largest bounds one batch's rows). ``max_queue_rows``
+    bounds admission — beyond it, :meth:`submit` raises
+    :class:`Overloaded` (the counted 429). ``heartbeat_dir`` publishes
+    per-replica liveness the router's ft-policy health checks consume.
+    The compiled forward, device placement, preprocess/postprocess
+    fusion, and mesh handling are
+    :class:`~sparktorch_tpu.inference.BatchPredictor`'s — this class
+    adds the online admission/coalescing/liveness layer on top.
+    """
+
+    def __init__(self, module, params, model_state=None, mesh=None,
+                 replica_id="0", buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_queue_rows: int = 256,
+                 default_deadline_s: float = 30.0,
+                 preprocess=None, postprocess=None,
+                 telemetry=None, heartbeat_dir: Optional[str] = None,
+                 heartbeat_interval_s: float = 0.25,
+                 warm_input=None, auto_start: bool = True,
+                 params_version: int = 0):
+        from sparktorch_tpu.inference import BatchPredictor
+        from sparktorch_tpu.obs import get_telemetry
+
+        self.replica_id = str(replica_id)
+        self.telemetry = telemetry or get_telemetry()
+        self._labels = {"replica": self.replica_id}
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive, got {buckets!r}")
+        self.max_queue_rows = int(max_queue_rows)
+        self.default_deadline_s = float(default_deadline_s)
+        self._bp = BatchPredictor(
+            module, params, model_state=model_state, mesh=mesh,
+            chunk=self.buckets[-1], preprocess=preprocess,
+            postprocess=postprocess, telemetry=self.telemetry,
+        )
+        # The coherent serving pair, swapped BETWEEN batches: the loop
+        # reads (params, model_state) in one atomic slot read per
+        # batch, so a live weight update can never mix new params with
+        # old state inside one compiled call.
+        self._slot = VersionedSlot((self._bp._params,
+                                    self._bp._model_state))
+        self.params_version = int(params_version)
+        self._cond = threading.Condition()
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._queued_rows = 0
+        self._admitted = 0
+        self._batches = 0
+        self._dead = False
+        self._stopped = False
+        self._hb = None
+        if heartbeat_dir:
+            from sparktorch_tpu.obs import HeartbeatEmitter
+
+            self._hb = HeartbeatEmitter(heartbeat_dir,
+                                        rank=int(self.replica_id),
+                                        telemetry=self.telemetry)
+        self._hb_interval = float(heartbeat_interval_s)
+        self._hb_last = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._warmed: set = set()
+        self._warm_lock = threading.Lock()
+        if warm_input is not None:
+            # Compile-once warmup: every bucket shape compiles NOW
+            # (one ``(n, *row_shape)`` sample is enough), so the first
+            # real request never pays a multi-second XLA compile.
+            self._warm_for(tuple(np.asarray(warm_input).shape[1:]),
+                           np.asarray(warm_input).dtype)
+        if auto_start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _warm_for(self, row_shape: Tuple[int, ...], dtype) -> None:
+        """Bucket warmup keyed on the observed row shape (the
+        constructor cannot know it unless given ``warm_input`` —
+        modules reshape): the first admission of a new shape compiles
+        every bucket up front — one stall, then steady state."""
+        # A SET of warmed keys, not just the last one: traffic
+        # alternating between two request shapes must not re-run the
+        # full bucket compile loop in the admission path per request.
+        key = (row_shape, str(dtype))
+        if key in self._warmed:
+            return
+        with self._warm_lock:
+            if key in self._warmed:
+                return
+            params, state = self._slot.read()[1]
+            t0 = time.perf_counter()
+            for b in self.buckets:
+                probe = np.zeros((b, *row_shape), dtype)
+                np.asarray(self._bp._fwd(params, state,
+                                         self._bp._put(probe)))
+            self._warmed.add(key)
+            self.telemetry.observe("serve.warmup_s",
+                                   time.perf_counter() - t0,
+                                   labels=self._labels)
+
+    def start(self) -> "InferenceReplica":
+        if self._thread is None or not self._thread.is_alive():
+            self._dead = False
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name=f"infer-replica-{self.replica_id}",
+            )
+            self._thread.start()
+        return self
+
+    def alive(self) -> bool:
+        return (not self._dead and not self._stopped
+                and self._thread is not None and self._thread.is_alive())
+
+    def kill(self) -> None:
+        """Crash the replica (the chaos path): queued requests fail
+        with :class:`ReplicaStopped` (the router re-routes them — zero
+        drops is the ROUTER'S contract, not a dead replica's), the
+        loop thread exits, and heartbeats simply STOP — the last beat
+        ages out, which is exactly the silent-death signature the
+        ft barrier deadline detects."""
+        with self._cond:
+            self._dead = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+            self._cond.notify_all()
+        for req in pending:
+            req.future._set_error(ReplicaStopped(
+                f"replica {self.replica_id} died"))
+        self.telemetry.counter("serve.replica_deaths_total",
+                               labels=self._labels)
+
+    def stop(self) -> None:
+        """Graceful shutdown: queued requests fail fast, the loop
+        exits, and the heartbeat closes with ``alive=False`` (a clean
+        stop is distinguishable from a crash)."""
+        with self._cond:
+            self._stopped = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+            self._cond.notify_all()
+        for req in pending:
+            req.future._set_error(ReplicaStopped(
+                f"replica {self.replica_id} stopped"))
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._hb is not None:
+            self._hb.close()
+
+    # -- weights ------------------------------------------------------------
+
+    def install_params(self, params, model_state=None,
+                       version: Optional[int] = None) -> None:
+        """Atomically swap the serving weights between batches. The
+        predictor's own fields update too (so a direct
+        ``predictor.predict`` agrees), but the batch loop executes
+        from the slot's coherent (params, state) pair."""
+        self._bp.update_params(params, model_state=model_state)
+        self._slot.swap((self._bp._params, self._bp._model_state))
+        if version is not None:
+            self.params_version = int(version)
+        else:
+            self.params_version += 1
+        self.telemetry.counter("serve.weight_swaps_total",
+                               labels=self._labels)
+        self.telemetry.gauge("serve.params_version", self.params_version,
+                             labels=self._labels)
+        self.telemetry.gauge("serve.weight_last_update_ts", time.time(),
+                             labels=self._labels)
+
+    @property
+    def predictor(self):
+        return self._bp
+
+    # -- admission ----------------------------------------------------------
+
+    @property
+    def queued_rows(self) -> int:
+        return self._queued_rows
+
+    def submit(self, x, deadline_s: Optional[float] = None,
+               trace_ctx=None) -> InferFuture:
+        """Admit one request (``x``: ``(n, *row_shape)``, n >= 1) into
+        the next in-flight batch. Returns immediately with a future;
+        raises :class:`Overloaded` (the counted 429) when the queue is
+        full, :class:`ReplicaStopped` when the replica is down, and
+        ``ValueError`` for a request bigger than the largest bucket
+        (that is a batch job — use the :class:`BatchPredictor`)."""
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(f"request needs a leading batch dim, "
+                             f"got shape {x.shape}")
+        if x.shape[0] > self.buckets[-1]:
+            raise ValueError(
+                f"request of {x.shape[0]} rows exceeds the largest "
+                f"bucket ({self.buckets[-1]}) — batch jobs go through "
+                f"BatchPredictor"
+            )
+        act = _chaos.fire("serve.replica", replica=self.replica_id)
+        if act and act.get("delay"):
+            # Straggler replica: correct, just slow. Slept in the
+            # ADMISSION path so a traced request attributes it to the
+            # router's replica hop — network-shaped latency lands on
+            # the hop, batch work on `execute`.
+            time.sleep(float(act["delay"]))
+        if act and act.get("die"):
+            self.kill()
+        if self._dead or self._stopped:
+            raise ReplicaStopped(f"replica {self.replica_id} is down")
+        self._warm_for(tuple(x.shape[1:]), x.dtype)
+        req = _Request(x, deadline_s if deadline_s is not None
+                       else self.default_deadline_s, trace_ctx)
+        with self._cond:
+            # Re-checked UNDER the condition: kill()/stop() drain the
+            # queue under this lock, so a request admitted after the
+            # lock-free check above but appended after the drain would
+            # otherwise be orphaned — its future never resolves.
+            if self._dead or self._stopped:
+                raise ReplicaStopped(
+                    f"replica {self.replica_id} is down")
+            if self._queued_rows + req.n > self.max_queue_rows:
+                self.telemetry.counter(
+                    "serve.rejected_total",
+                    labels={**self._labels, "reason": "backpressure"})
+                raise Overloaded(
+                    f"replica {self.replica_id} queue full "
+                    f"({self._queued_rows}/{self.max_queue_rows} rows)"
+                )
+            self._queue.append(req)
+            self._queued_rows += req.n
+            self._admitted += 1
+            self._cond.notify()
+        self.telemetry.counter("serve.requests_total", labels=self._labels)
+        self.telemetry.counter("serve.rows_total", float(req.n),
+                               labels=self._labels)
+        return req.future
+
+    def infer(self, x, deadline_s: Optional[float] = None,
+              timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: submit + wait."""
+        return self.submit(x, deadline_s=deadline_s).result(
+            timeout if timeout is not None
+            else (deadline_s or self.default_deadline_s) + 5.0)
+
+    # -- the batch loop -----------------------------------------------------
+
+    def _beat(self, force: bool = False) -> None:
+        if self._hb is None:
+            return
+        now = time.monotonic()
+        if force or now - self._hb_last >= self._hb_interval:
+            self._hb_last = now
+            self._hb.notify_step(self._batches)
+
+    def _pop_batch(self) -> List[_Request]:
+        """Coalesce queued requests (FIFO, deterministic) into one
+        batch up to the largest bucket. Only requests sharing the
+        head's (row_shape, dtype) coalesce — np.concatenate across
+        mixed shapes would crash the shared batch; a mismatched head
+        simply starts the NEXT batch, FIFO order preserved. Called
+        under the condition."""
+        batch: List[_Request] = []
+        rows = 0
+        key = None
+        while self._queue and rows + self._queue[0].n <= self.buckets[-1]:
+            head = self._queue[0]
+            hkey = (head.x.shape[1:], head.x.dtype)
+            if key is None:
+                key = hkey
+            elif hkey != key:
+                break
+            req = self._queue.popleft()
+            self._queued_rows -= req.n
+            rows += req.n
+            batch.append(req)
+        return batch
+
+    def _serve_loop(self) -> None:
+        from sparktorch_tpu.obs.rpctrace import tracer_for
+
+        tracer = tracer_for(self.telemetry)
+        tele = self.telemetry
+        while True:
+            with self._cond:
+                while (not self._queue and not self._dead
+                       and not self._stopped):
+                    self._cond.wait(timeout=self._hb_interval)
+                    self._beat()  # idle liveness: beats without traffic
+                if self._dead or self._stopped:
+                    return
+                batch = self._pop_batch()
+                depth = self._queued_rows
+            tele.observe("serve.queue_depth", depth, labels=self._labels)
+            pop_t0 = time.perf_counter()
+
+            live: List[_Request] = []
+            for req in batch:
+                if pop_t0 > req.deadline_t:
+                    # Expired while queued: fail it here rather than
+                    # burn a batch slot computing rows nobody waits
+                    # for.
+                    tele.counter("serve.deadline_expired_total",
+                                 labels=self._labels)
+                    req.future._set_error(DeadlineExceeded(
+                        f"deadline lapsed after "
+                        f"{pop_t0 - req.enq_t0:.3f}s in queue"))
+                else:
+                    live.append(req)
+            if not live:
+                continue
+
+            rows = sum(r.n for r in live)
+            bucket = next(b for b in self.buckets if b >= rows)
+
+            # ONE slot read per batch: params and model_state flip
+            # together (the live-update atomicity contract).
+            _sv, (params, state) = self._slot.read()
+            exec_ts = time.time()
+            exec_t0 = time.perf_counter()
+            try:
+                # Pad/concat inside the guarded region: ANY failure
+                # assembling or executing the batch must fail this
+                # batch's futures, never kill the loop thread (a dead
+                # loop orphans every queued request silently).
+                xs = [r.x for r in live]
+                if rows < bucket:
+                    xs.append(np.zeros((bucket - rows, *xs[0].shape[1:]),
+                                       xs[0].dtype))
+                padded = xs[0] if len(xs) == 1 else np.concatenate(xs)
+                out = np.asarray(
+                    self._bp._fwd(params, state, self._bp._put(padded)))
+            except Exception as e:  # noqa: BLE001 - batch must not kill loop
+                tele.counter("serve.batch_errors_total",
+                             labels=self._labels)
+                for req in live:
+                    req.future._set_error(e)
+                continue
+            exec_dur = time.perf_counter() - exec_t0
+            done_t = time.perf_counter()
+            self._batches += 1
+            self._beat(force=True)
+
+            tele.observe("serve.batch_fill", rows / bucket,
+                         labels=self._labels)
+            tele.observe("serve.batch_exec_s", exec_dur,
+                         labels=self._labels)
+            tele.counter("serve.batches_total", labels=self._labels)
+            tele.gauge("serve.last_bucket", bucket, labels=self._labels)
+
+            offset = 0
+            for req in live:
+                req_out = out[offset:offset + req.n]
+                offset += req.n
+                if req.trace_ctx is not None and req.trace_ctx.sampled:
+                    # The router's replica-hop span is the parent:
+                    # queue_wait (admission -> batch pop) and execute
+                    # (the shared compiled call) land under it, so the
+                    # waterfall says WHERE the request's time went.
+                    tracer.record("queue_wait", req.trace_ctx,
+                                  req.enq_ts, pop_t0 - req.enq_t0,
+                                  kind="server",
+                                  replica=self.replica_id)
+                    tracer.record("execute", req.trace_ctx, exec_ts,
+                                  exec_dur, kind="server",
+                                  replica=self.replica_id,
+                                  bucket=bucket, batch_rows=rows)
+                tele.observe("serve.request_latency_s",
+                             done_t - req.enq_t0, labels=self._labels)
+                req.future._set_result(req_out)
+
+
+# ---------------------------------------------------------------------------
+# Live weight updates
+# ---------------------------------------------------------------------------
+
+
+class WeightPuller:
+    """Background weight refresh for one replica.
+
+    ``transport`` is anything speaking the hogwild pull contract:
+
+    - a :class:`~sparktorch_tpu.net.transport.BinaryTransport` —
+      version-tagged pulls against a single param server; when the
+      server also serves ``/delta.bin`` (the fleet GATEWAY's
+      assembled deltas, or a shard that owns the WHOLE tree —
+      single-shard fleet), per-tensor DELTA pulls are used
+      automatically (only advanced leaves ship; 404 from a pre-delta
+      server degrades to full pulls, once, permanently). A bare shard
+      of a multi-shard fleet serves only its hash range — point the
+      transport at the gateway, or use a ShardedTransport, for those;
+    - a :class:`~sparktorch_tpu.net.sharded.ShardedTransport` — delta
+      scatter/gather across the shard fleet (its ``pull`` is already
+      delta-based internally).
+
+    Every fresh pull installs atomically via
+    :meth:`InferenceReplica.install_params`; a pull failure counts and
+    leaves the replica serving its last-good weights (staleness is
+    the correct degraded mode for serving — never an outage).
+    """
+
+    def __init__(self, replica: InferenceReplica, transport,
+                 poll_s: float = 0.05, quant: Optional[str] = None,
+                 telemetry=None):
+        self.replica = replica
+        self.transport = transport
+        self.poll_s = float(poll_s)
+        self.quant = quant
+        self.telemetry = telemetry or replica.telemetry
+        self._labels = dict(replica._labels)
+        self._have = -1
+        self._epoch: Optional[int] = None
+        self._leaves: Dict[Tuple[str, ...], np.ndarray] = {}
+        # None = undecided (probe /delta.bin first); False = the
+        # server 404'd it (pre-delta wire) — full pulls from then on.
+        self._use_delta: Optional[bool] = (
+            None if hasattr(transport, "pull_delta") else False
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "WeightPuller":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"weight-puller-{self.replica.replica_id}",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        close = getattr(self.transport, "close", None)
+        if close is not None:
+            close()
+
+    @property
+    def version(self) -> int:
+        return self._have
+
+    def poll_once(self) -> bool:
+        """One pull sweep; True when fresh weights were installed."""
+        t0 = time.perf_counter()
+        try:
+            if self._use_delta is not False:
+                fresh = self._poll_delta()
+            else:
+                fresh = self._poll_full()
+        finally:
+            self.telemetry.observe("serve.weight_poll_s",
+                                   time.perf_counter() - t0,
+                                   labels=self._labels)
+        if fresh:
+            self.telemetry.counter("serve.weight_updates_total",
+                                   labels=self._labels)
+        return fresh
+
+    def _poll_delta(self) -> bool:
+        try:
+            res = self.transport.pull_delta(lambda: self._have,
+                                            quant=self.quant)
+        except TransportError as e:
+            if self._use_delta is None and "404" in str(e):
+                # Pre-delta server (single ParameterServer): remember
+                # and fall back to full version-tagged pulls.
+                self._use_delta = False
+                return self._poll_full()
+            raise
+        self._use_delta = True
+        epoch = res.get("epoch")
+        if (epoch is not None and self._epoch is not None
+                and epoch != self._epoch):
+            # Server slot rebuilt (restart/re-add): its version
+            # counter restarted, our have-version and leaf cache are
+            # meaningless — full resync.
+            self._have = -1
+            self._leaves.clear()
+            self.telemetry.counter("serve.weight_epoch_resyncs_total",
+                                   labels=self._labels)
+            res = self.transport.pull_delta(lambda: self._have,
+                                            quant=self.quant)
+            epoch = res.get("epoch")
+        if epoch is not None:
+            self._epoch = epoch
+        if not res.get("fresh"):
+            return False
+        self._leaves.update(res["leaves"])
+        self._have = int(res["version"])
+        tree = _wire.unflatten_tree(list(self._leaves.items()))
+        self.replica.install_params(tree, version=self._have)
+        return True
+
+    def _poll_full(self) -> bool:
+        snap = self.transport.pull(self._have)
+        if snap is None:
+            return False
+        version, tree = snap
+        self._have = int(version)
+        self.replica.install_params(tree, version=self._have)
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except (TransportError, _wire.WireError, OSError):
+                # Stale-but-serving beats dead: count it, keep the
+                # last-good weights, retry next tick.
+                self.telemetry.counter("serve.weight_pull_errors_total",
+                                       labels=self._labels)
+            self._stop.wait(self.poll_s)

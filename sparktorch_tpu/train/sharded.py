@@ -166,10 +166,11 @@ def make_sharded_train_step(
     themselves (the mesh was not known until now). Known cost: the
     winner's GSPMD program compiles once inside the tuner's
     measurement and once more for this fresh step closure (jit cannot
-    dedupe across closures) — amortized over a training run, and the
-    per-(workload, rig) tune-result cache filed in ROADMAP item 4's
-    follow-ups is the path to skipping the search (and this recompile)
-    entirely on re-runs.
+    dedupe across closures) — amortized over a training run; RE-runs
+    of the same (workload, rig) skip the whole search via the
+    tune-result cache (on by default here; ``tune_kwargs={'cache':
+    False}`` or ``SPARKTORCH_TPU_TUNE_CACHE=0`` opts out, and the
+    artifact records ``cache_hit``).
 
     Telemetry/tracing (same contract as the sync/pp trainers'
     ``profile_dir``): every call of the returned ``run`` carries a
@@ -202,6 +203,11 @@ def make_sharded_train_step(
         # longer matches jax.devices().
         tune_kwargs = dict(tune_kwargs or {})
         devices = tune_kwargs.pop("devices", None) or jax.devices()
+        # Re-runs of the same workload on the same rig load the
+        # cached winner instead of re-searching (and re-compiling
+        # every candidate) — SPARKTORCH_TPU_TUNE_CACHE=0 opts out,
+        # tune_kwargs={'cache': False} opts out per call.
+        tune_kwargs.setdefault("cache", True)
         tune_result = autotune(
             spec, sample_batch, devices, tx=tx, seq_sharded=seq_sharded,
             telemetry=telemetry, **tune_kwargs,

@@ -74,6 +74,14 @@ if _CACHE_DIR:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+# The tune-result cache is OFF by default for the suite: tests must
+# be hermetic (no reads of — or writes to — the user's ~/.cache, and
+# no cross-run coupling where a stale entry from an older code
+# version decides a deterministic assertion). The cache's own tests
+# point SPARKTORCH_TPU_TUNE_CACHE at a tmp dir explicitly; an
+# externally-set value is respected.
+os.environ.setdefault("SPARKTORCH_TPU_TUNE_CACHE", "0")
+
 import numpy as np
 import pytest
 

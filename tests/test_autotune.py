@@ -536,3 +536,123 @@ def test_resolve_alpha_priority_env_probe_default(monkeypatch):
                             RuntimeError("rig on fire")))
     value, source = resolve_alpha_bytes()
     assert source == "default" and value > 0
+
+
+# ---------------------------------------------------------------------------
+# Tune-result cache (ROADMAP item-4 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def _cache_key_inputs():
+    """Replicate autotune's key resolution for _fake_spec_and_batch:
+    analytic transformer shape, default caps with sp locked (scalar
+    labels), default axes/search knobs."""
+    from sparktorch_tpu.parallel.tune import (
+        DEFAULT_AXES,
+        tune_cache_key,
+        workload_for,
+    )
+
+    spec, batch = _fake_spec_and_batch()
+    shape, cfg = workload_for(spec, batch)
+    caps = dict(transformer_caps(cfg, shape.seq_len))
+    caps["sp"] = (1,)
+    devices = list(range(8))  # fingerprint only getattrs these
+    key = tune_cache_key(shape, caps, DEFAULT_AXES, devices,
+                         seq_sharded=False, measure_top_k=4,
+                         exposed_weight=0.25)
+    return spec, batch, devices, key
+
+
+def test_tune_cache_key_fingerprints_workload_and_rig():
+    from sparktorch_tpu.parallel.tune import (
+        DEFAULT_AXES,
+        tune_cache_key,
+        workload_for,
+    )
+
+    spec, batch = _fake_spec_and_batch()
+    shape, cfg = workload_for(spec, batch)
+    caps = dict(transformer_caps(cfg, shape.seq_len))
+    devices = list(range(8))
+    key = tune_cache_key(shape, caps, DEFAULT_AXES, devices, False, 4, 0.25)
+    # Deterministic for identical inputs.
+    assert key == tune_cache_key(shape, caps, DEFAULT_AXES, devices,
+                                 False, 4, 0.25)
+    # A different global batch is a different workload...
+    import dataclasses as _dc
+
+    other = _dc.replace(shape, global_batch=shape.global_batch * 2)
+    assert tune_cache_key(other, caps, DEFAULT_AXES, devices,
+                          False, 4, 0.25) != key
+    # ...and a different device count is a different rig.
+    assert tune_cache_key(shape, caps, DEFAULT_AXES, devices[:4],
+                          False, 4, 0.25) != key
+
+
+def test_tune_cache_dir_env_semantics(monkeypatch, tmp_path):
+    from sparktorch_tpu.parallel.tune import TUNE_CACHE_ENV, _tune_cache_dir
+
+    monkeypatch.setenv(TUNE_CACHE_ENV, "0")
+    assert _tune_cache_dir() is None
+    monkeypatch.setenv(TUNE_CACHE_ENV, str(tmp_path))
+    assert _tune_cache_dir() == str(tmp_path)
+    monkeypatch.delenv(TUNE_CACHE_ENV)
+    default = _tune_cache_dir()
+    assert default is not None and "sparktorch_tpu" in default
+
+
+def test_tune_cache_hit_skips_search_and_stamps_artifact(
+        monkeypatch, tmp_path):
+    """autotune(cache=True) finding a cached entry for the same
+    (workload, rig, search space) returns it WITHOUT searching —
+    nothing is measured — and both the returned result and the
+    written artifact record cache_hit + the key."""
+    from sparktorch_tpu.parallel.tune import (
+        TUNE_CACHE_ENV,
+        _cache_load,
+        _cache_store,
+    )
+
+    monkeypatch.setenv(TUNE_CACHE_ENV, str(tmp_path))
+    spec, batch, devices, key = _cache_key_inputs()
+    seeded = TuneResult(
+        n_devices=8, global_batch=32, best={"dp": 8}, candidates=[],
+        noise_floor_s=0.0, early_stopped=False, steps_per_candidate=1,
+        wall_s=1.0, exposed_weight=0.25,
+    )
+    _cache_store(key, seeded)
+    assert _cache_load(key) is not None  # the key replication holds
+    artifact = str(tmp_path / "tune_result.json")
+    result = autotune(spec, batch, devices, cache=True,
+                      artifact_path=artifact)
+    assert result.cache_hit is True
+    assert result.cache_key == key
+    assert result.best_label == "dp8"
+    with open(artifact) as f:
+        doc = json.load(f)
+    assert doc["cache_hit"] is True and doc["cache_key"] == key
+    # Round-trip keeps the stamp.
+    assert TuneResult.load(artifact).cache_hit is True
+
+
+def test_scripted_and_exhaustive_searches_never_touch_cache(
+        monkeypatch, tmp_path):
+    """A measure_fn (scripted test) or exhaustive (referee) run must
+    neither read nor write the cache — a cache entry satisfying the
+    bench's referee would void the gate."""
+    from sparktorch_tpu.parallel.tune import TUNE_CACHE_ENV
+
+    monkeypatch.setenv(TUNE_CACHE_ENV, str(tmp_path))
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+    walls = {label: (0.010, 0.002) for label in [
+        "dp8", "fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+        "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"]}
+    result = autotune(spec, batch, devices, steps=1, repeats=1,
+                      min_rounds=1, measure_top_k=2,
+                      measure_fn=_fake_measure(walls),
+                      alpha_bytes=1 << 20, cache=True)
+    assert result.cache_hit is False
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("tune_")]

@@ -882,3 +882,90 @@ def test_collector_serial_mode_unchanged():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Gateway delta pulls (ROADMAP item-1 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_assembles_delta_frames_across_shards(payload):
+    """The gateway's /delta.bin serves ONE v2 frame assembled from
+    every shard's per-leaf state: a full sync from scratch, a real
+    304 when current, and — after a sparse push — only the changed
+    leaves, strictly fewer bytes than the full sync. Legacy-topology
+    clients get the delta byte win without speaking the ring."""
+    import jax
+
+    fleet = ParamServerFleet(payload, n_shards=3).start()
+    transport = BinaryTransport(fleet.gateway_url, quant=None)
+    try:
+        res = transport.pull_delta(-1)
+        assert res["fresh"] and res["epoch"] is not None
+        full_bytes = res["nbytes"]
+        ref = dict(wire.flatten_tree(jax.tree.map(
+            lambda a: np.asarray(a), fleet.assemble())))
+        assert set(res["leaves"]) == set(ref)
+        for path in ref:
+            assert np.allclose(res["leaves"][path], ref[path],
+                               atol=1e-6), path
+        have = res["version"]
+
+        # Up to date -> a real 304 (no bytes, fresh=False).
+        again = transport.pull_delta(have)
+        assert not again["fresh"]
+
+        # Sparse push -> only the touched leaf ships.
+        hot = sorted(ref)[0]
+        fleet.scatter_push({hot: np.ones_like(ref[hot])}, wait=True)
+        delta = transport.pull_delta(have)
+        assert delta["fresh"]
+        assert set(delta["leaves"]) == {hot}
+        assert 0 < delta["nbytes"] < full_bytes
+        now = dict(wire.flatten_tree(jax.tree.map(
+            lambda a: np.asarray(a), fleet.assemble())))
+        assert np.allclose(delta["leaves"][hot], now[hot], atol=1e-6)
+    finally:
+        transport.close()
+        fleet.stop()
+
+
+def test_gateway_delta_int8_and_drain_stay_monotonic(payload):
+    """int8 gateway deltas dequantize close to the served leaf (one
+    shared quantization per state, gateway-side error feedback), and
+    a mid-stream drain_shard keeps the composite version monotonic —
+    the client's next delta re-ships exactly the state it is missing,
+    never 304s through a real change."""
+    import jax
+
+    fleet = ParamServerFleet(payload, n_shards=3).start()
+    transport = BinaryTransport(fleet.gateway_url, quant=None)
+    try:
+        res = transport.pull_delta(-1)
+        have = res["version"]
+        ref = dict(wire.flatten_tree(jax.tree.map(
+            lambda a: np.asarray(a), fleet.assemble())))
+        hot = sorted(ref)[0]
+        fleet.scatter_push({hot: np.ones_like(ref[hot])}, wait=True)
+        q = transport.pull_delta(have, quant="int8")
+        assert q["fresh"] and set(q["leaves"]) == {hot}
+        now = dict(wire.flatten_tree(jax.tree.map(
+            lambda a: np.asarray(a), fleet.assemble())))
+        err = np.abs(q["leaves"][hot] - now[hot]).max()
+        assert err < np.abs(now[hot]).max() / 100 + 1e-3
+        have = q["version"]
+
+        # Drain a shard: version stays monotonic and the migrated
+        # leaves' next delta matches the live assembled state.
+        victim = fleet.ring.shard_ids[0]
+        fleet.drain_shard(victim)
+        after = transport.pull_delta(have)
+        assert after["version"] >= have
+        if after["fresh"]:
+            live = dict(wire.flatten_tree(jax.tree.map(
+                lambda a: np.asarray(a), fleet.assemble())))
+            for path, leaf in after["leaves"].items():
+                assert np.allclose(leaf, live[path], atol=1e-6), path
+    finally:
+        transport.close()
+        fleet.stop()
