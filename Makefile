@@ -6,8 +6,8 @@ PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
-	bench-ps-fleet bench-tune bench-rpc-trace bench-serve cluster-up \
-	clean lint-obs
+	bench-ps-fleet bench-tune bench-rpc-trace bench-serve \
+	bench-elastic cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -210,6 +210,23 @@ bench-serve:
 # Runs on any backend (JAX_PLATFORMS=cpu works).
 bench-ps-fleet:
 	$(PYTHON) -m sparktorch_tpu.bench --config hogwild_ps_fleet
+
+# Elastic control-plane gate: one supervised MULTI-PROCESS run (real
+# `python -m sparktorch_tpu.ctl.worker` children) must survive a
+# seeded NON-COOPERATIVE kill (chaos kill_process_at: raw SIGKILL, no
+# cancel event — restart, recovery latency bounded), a restart-budget
+# exhaustion (world SHRINK through the native coordinator, the dead
+# rank's partitions redistributed, training continues), and a rejoin
+# (world GROW) — with every partition completed EXACTLY once and every
+# transition visible as a generation-tagged event in the collector's
+# /gang view — FAILS otherwise. The record is retained (--log) so the
+# recovery-latency drift gate arms against prior rounds
+# (SPARKTORCH_TPU_ELASTIC_DRIFT_TOL, relative, default 2.0). The ctl
+# modules are covered by lint-obs like everything else under
+# sparktorch_tpu/. Runs on any backend (JAX_PLATFORMS=cpu works).
+bench-elastic:
+	$(PYTHON) -m sparktorch_tpu.bench --config elastic_ctl \
+		--log benchmarks/bench_r08_elastic.jsonl
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

@@ -214,16 +214,29 @@ void handle_conn(GangServer *srv, int fd) {
     } else if (line.rfind("BAR ", 0) == 0) {
       long epoch = atol(line.c_str() + 4);
       std::unique_lock<std::mutex> lock(st.mu);
+      // The generation this waiter parked under: an elastic resize
+      // bumps it WITHOUT latching failure (it clears barrier_count and
+      // the failure latch while waiters may still be parked), so the
+      // wait must also release on a generation change — otherwise a
+      // parked waiter re-evaluates (cleared count, failure unlatched)
+      // to false and re-parks forever, or worse, a new generation
+      // reusing this epoch number refills barrier_count[epoch] and
+      // hands the stale waiter a spurious GO into a gang that no
+      // longer includes it.
+      long entry_gen = st.generation.load();
       st.barrier_count[epoch]++;
       st.cv.notify_all();
       st.cv.wait(lock, [&] {
         return st.barrier_count[epoch] >= st.world_size ||
-               st.failed.load() || !st.running.load();
+               st.generation.load() != entry_gen || st.failed.load() ||
+               !st.running.load();
       });
-      // GO only for a genuinely complete barrier: a waiter released by
-      // failure OR coordinator shutdown must see an error, never a
-      // spurious green light into a collective that will hang.
-      bool complete = st.barrier_count[epoch] >= st.world_size;
+      // GO only for a genuinely complete barrier OF THIS GENERATION: a
+      // waiter released by failure, resize, or coordinator shutdown
+      // must see an error (it re-registers fresh), never a spurious
+      // green light into a collective that will hang.
+      bool complete = st.barrier_count[epoch] >= st.world_size &&
+                      st.generation.load() == entry_gen;
       lock.unlock();
       write_all(fd, (complete && !st.failed.load() && st.running.load())
                         ? "GO\n"
@@ -399,6 +412,40 @@ int gang_server_run_id(void *p, char *buf, int buflen) {
 }
 
 int gang_server_port(void *p) { return static_cast<GangServer *>(p)->port; }
+
+// Elastic resize: change the gang's world size LIVE. A resize is a
+// membership event exactly like a rejoin-after-failure — the world the
+// surviving ranks registered into no longer exists — so it reuses the
+// same machinery: bump the generation, clear membership / heartbeat
+// slots / barrier counts, clear the failure latch, and release every
+// parked barrier waiter (they see DEAD and re-register, tagged fresh,
+// into the new generation). Returns the NEW generation, or -1 on a
+// bad world size. The elastic controller drives this when a rank
+// exhausts its restart budget (shrink) or a new host joins (grow).
+long gang_server_resize(void *p, int new_world_size) {
+  if (new_world_size < 1) return -1;
+  auto *srv = static_cast<GangServer *>(p);
+  GangState &st = srv->state;
+  long gen;
+  {
+    std::lock_guard<std::mutex> lock(st.mu);
+    st.world_size = new_world_size;
+    gen = st.generation.fetch_add(1) + 1;
+    st.members.clear();
+    st.last_beat.clear();
+    st.barrier_count.clear();
+    st.failed.store(false);
+    st.dead_rank.store(-1);
+    st.cv.notify_all();
+  }
+  return gen;
+}
+
+int gang_server_world_size(void *p) {
+  auto *srv = static_cast<GangServer *>(p);
+  std::lock_guard<std::mutex> lock(srv->state.mu);
+  return srv->state.world_size;
+}
 
 long gang_server_generation(void *p) {
   return static_cast<GangServer *>(p)->state.generation.load();

@@ -2769,6 +2769,226 @@ def bench_hogwild_chaos_soak(rounds: int = 4, iters: int = 16,
     }
 
 
+def bench_elastic_ctl(n_parts: int = 36, part_sleep_s: float = 0.4,
+                      recovery_bound_s: float = 30.0) -> dict:
+    """Elastic control-plane gate (``make bench-elastic``): one
+    supervised MULTI-PROCESS run (real ``python -m sparktorch_tpu.ctl.
+    worker`` children) must survive, in a single world, the three
+    transitions the controller exists for —
+
+    - a seeded NON-COOPERATIVE kill (chaos ``kill_process_at``: raw
+      SIGKILL delivered by the controller's own liveness poll, no
+      cancel event, no grace) -> restart, recovery latency bounded;
+    - a restart-budget EXHAUSTION (one rank crashes on every attempt)
+      -> world SHRINK through the native coordinator (generation
+      bump), the dead rank's partitions redistributed, run continues;
+    - a REJOIN (a new rank added after the shrink) -> world GROW,
+      another generation.
+
+    FAILS (raises) unless: every partition completes EXACTLY once
+    (atomic rename + skip-if-exists idempotency — no loss, no double
+    work), the chaos kill fired exactly once, shrink and grow each
+    happened exactly once with the coordinator's generation following,
+    and every transition is visible as a generation-tagged event in
+    the fleet collector's ``/gang`` view scraped over HTTP. A
+    recovery-latency drift gate arms once a prior record is retained
+    (``SPARKTORCH_TPU_ELASTIC_DRIFT_TOL``, relative, default 2.0 —
+    child-process boot cost breathes with rig load)."""
+    import os
+    import tempfile
+    import threading
+
+    from sparktorch_tpu.ctl import ElasticController, spawn_worker
+    from sparktorch_tpu.ft import ChaosConfig, FtPolicy, RestartPolicy, inject
+    from sparktorch_tpu.native.gang import GangCoordinator, GangMetricsExporter
+    from sparktorch_tpu.obs import Telemetry
+    from sparktorch_tpu.obs.collector import FleetCollector, scrape_json
+
+    t_start = time.perf_counter()
+    tele = Telemetry(run_id="bench_elastic")
+    workdir = tempfile.mkdtemp(prefix="bench_elastic_")
+    out = os.path.join(workdir, "parts")
+    hb_dir = os.path.join(workdir, "hb")
+    os.makedirs(out)
+    work = [f"part{i:03d}" for i in range(n_parts)]
+
+    def completed(p):
+        return os.path.exists(os.path.join(out, p + ".done"))
+
+    def start_fn(rank, attempt, generation, assignment):
+        def workfn(ctx, _parts=tuple(assignment), _rank=rank,
+                   _gen=generation, _out=out, _sleep=part_sleep_s):
+            import os as _os
+            import time as _t
+
+            if _rank == 1:
+                raise RuntimeError("rank1 permanently broken")
+            for i, p in enumerate(_parts):
+                if ctx.should_stop():
+                    return
+                ctx.notify_step(i)
+                path = _os.path.join(_out, p + ".done")
+                if _os.path.exists(path):
+                    continue
+                tmp = path + f".tmp{_os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(f"{_rank}:{_gen}")
+                _os.replace(tmp, path)
+                _t.sleep(_sleep)
+
+        return spawn_worker(workfn, rank=rank, heartbeat_dir=hb_dir,
+                            name=f"rank{rank}", telemetry=tele)
+
+    coord = GangCoordinator(world_size=3, port=0,
+                            heartbeat_timeout_ms=30_000)
+    exporter = GangMetricsExporter(heartbeat_dir=hb_dir, coordinator=coord,
+                                   telemetry=tele, port=0).start()
+    collector = FleetCollector({0: exporter.url}, telemetry=tele,
+                               poll_interval_s=0.25)
+    collector.start(poll_loop=True)
+    policy = FtPolicy(restart=RestartPolicy(max_restarts=2,
+                                            backoff_base_s=0.05,
+                                            backoff_max_s=0.2), seed=0)
+    ctl = ElasticController(work, completed, policy=policy, telemetry=tele,
+                            coordinator=coord, collector=collector,
+                            min_world=1, name="bench_elastic")
+    for r in range(3):
+        ctl.add_rank(r, start_fn)
+
+    def grower():
+        # The rejoin: a NEW rank joins right after the shrink lands,
+        # so the gate always sees shrink THEN grow in one run.
+        deadline = time.time() + 120.0
+        while time.time() < deadline and not ctl._stop.is_set():
+            if ctl._resizes["shrink"] >= 1:
+                ctl.grow(3, start_fn)
+                return
+            time.sleep(0.05)
+
+    threading.Thread(target=grower, name="bench-elastic-grower",
+                     daemon=True).start()
+    try:
+        with inject(ChaosConfig(seed=11, kill_process_at={0: 2}),
+                    telemetry=tele) as inj:
+            summary = ctl.run(poll_interval_s=0.05, deadline_s=240.0)
+        gang_doc = scrape_json(
+            f"http://127.0.0.1:{collector.port}/gang")
+    finally:
+        collector.stop()
+        exporter.stop()
+        coord.stop()
+
+    # -- gates ---------------------------------------------------------
+    missing = [p for p in work if not completed(p)]
+    if missing or summary["work_pending"]:
+        raise AssertionError(f"partitions incomplete: {missing}")
+    torn = [f for f in os.listdir(out) if ".tmp" in f]
+    if torn:
+        raise AssertionError(f"torn partition outputs left behind: {torn}")
+    if len(os.listdir(out)) != n_parts:
+        raise AssertionError(
+            f"{len(os.listdir(out))} outputs != {n_parts} partitions")
+    kills_fired = [e for e in inj.events if e["site"] == "ctl.process"]
+    if len(kills_fired) != 1 or kills_fired[0]["rank"] != 0:
+        raise AssertionError(
+            f"chaos kill_process_at fired {kills_fired} (want exactly "
+            "one SIGKILL on rank 0)")
+    if summary["resizes"] != {"shrink": 1, "grow": 1}:
+        raise AssertionError(f"resizes {summary['resizes']} != "
+                             "{'shrink': 1, 'grow': 1}")
+    if summary["removed"] != [1]:
+        raise AssertionError(f"removed {summary['removed']} != [1]")
+    kinds = [h["kind"] for h in ctl.history]
+    for needed in ("restart", "shrink", "grow"):
+        if needed not in kinds:
+            raise AssertionError(
+                f"no {needed!r} event in the controller history {kinds}")
+    untagged = [h for h in ctl.history if "generation" not in h]
+    if untagged:
+        raise AssertionError(f"events missing generation tags: {untagged}")
+    if not (coord.generation == ctl.generation == summary["generation"]
+            >= 2):
+        raise AssertionError(
+            f"generation disagreement: coordinator {coord.generation}, "
+            f"controller {ctl.generation}, summary "
+            f"{summary['generation']} (want agreement, >= 2)")
+    if coord.world_size != 3:  # ranks 0, 2 and the joined 3
+        raise AssertionError(
+            f"coordinator world_size {coord.world_size} != 3 after "
+            "shrink+grow")
+    # Every transition visible in the collector's /gang answer.
+    elastic_doc = gang_doc.get("elastic") or {}
+    doc_kinds = [h.get("kind") for h in elastic_doc.get("history", [])]
+    for needed in ("restart", "shrink", "grow"):
+        if needed not in doc_kinds:
+            raise AssertionError(
+                f"/gang elastic history lacks {needed!r}: {doc_kinds}")
+    if elastic_doc.get("generation") != summary["generation"] or \
+            elastic_doc.get("resizes") != summary["resizes"]:
+        raise AssertionError(
+            f"/gang elastic doc {elastic_doc.get('generation')}/"
+            f"{elastic_doc.get('resizes')} disagrees with the run "
+            f"summary {summary['generation']}/{summary['resizes']}")
+    # Recovery latency: the restart of the SIGKILLed rank, detection
+    # to relaunch, bounded (generous — child boot rides rig load).
+    recovery = [
+        v["max"] for k, v in tele.snapshot()["histograms"].items()
+        if k.startswith("ft_recovery_latency_s") and v["count"]
+    ]
+    if not recovery or max(recovery) > recovery_bound_s:
+        raise AssertionError(
+            f"recovery latency {recovery} empty or past the "
+            f"{recovery_bound_s}s bound")
+    # Redistribution really happened: generations past 0 completed
+    # partitions too (the shrunk/grown worlds carried the tail).
+    by_gen: Dict[str, int] = {}
+    for p in work:
+        with open(os.path.join(out, p + ".done")) as f:
+            _, gen = f.read().split(":")
+        by_gen[gen] = by_gen.get(gen, 0) + 1
+    if len(by_gen) < 2:
+        raise AssertionError(
+            f"all partitions completed in one generation ({by_gen}) — "
+            "the resizes never redistributed work")
+
+    # -- drift gate (arms once a prior record is retained) -------------
+    tol = float(os.environ.get("SPARKTORCH_TPU_ELASTIC_DRIFT_TOL", "2.0"))
+    recovery_max = max(recovery)
+    prior = _prior_record("elastic_ctl", "recovery_latency_s")
+    if prior is None:
+        drift = {"status": "no_prior_record", "tolerance": tol}
+    else:
+        prior_lat = float(prior["recovery_latency_s"])
+        drift = {
+            "status": "checked", "tolerance": tol,
+            "prior_ts": prior.get("ts"),
+            "prior_recovery_latency_s": round(prior_lat, 3),
+            "ratio": round(recovery_max / max(prior_lat, 1e-9), 3),
+        }
+        if recovery_max > prior_lat * (1.0 + tol) + 1.0:
+            raise AssertionError(
+                f"recovery latency regressed: {recovery_max:.2f}s vs "
+                f"prior {prior_lat:.2f}s (past the {tol} relative "
+                f"tolerance + 1s floor); drift: {drift}")
+
+    return {
+        "config": "elastic_ctl", "unit": "s (recovery latency)",
+        "value": round(recovery_max, 3),
+        "recovery_latency_s": round(recovery_max, 3),
+        "n_parts": n_parts,
+        "restarts": summary["restarts"],
+        "resizes": summary["resizes"],
+        "removed": summary["removed"],
+        "generation": summary["generation"],
+        "world_size": summary["world_size"],
+        "parts_by_generation": dict(sorted(by_gen.items())),
+        "chaos_kills": len(kills_fired),
+        "records_exact": True,
+        "elastic_drift": drift,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+    }
+
+
 def _bert_flops_accounting(module, batch: int, seq: int) -> dict:
     """Honest model-FLOPs accounting for the BERT classifier.
 
@@ -3130,6 +3350,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_wire": bench_hogwild_wire,
     "hogwild_chaos": bench_hogwild_chaos,
     "hogwild_chaos_soak": bench_hogwild_chaos_soak,
+    "elastic_ctl": bench_elastic_ctl,
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "serve_online": bench_serve_online,
     "rpc_trace": bench_rpc_trace,

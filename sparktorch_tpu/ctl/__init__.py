@@ -1,0 +1,46 @@
+"""sparktorch_tpu.ctl — the elastic gang control plane.
+
+Driver-side process supervision for multi-host runs: real process
+workers with non-cooperative preemption (:mod:`ctl.proc`), one
+executable entry shape for every worker kind (:mod:`ctl.worker`), an
+elastic controller that shrinks/grows the world live instead of
+failing the run (:mod:`ctl.elastic`), and the authenticated control
+route (``POST /ctl``) that lets the controller manage ranks it has no
+local handle on (:mod:`ctl.route`).
+"""
+
+from sparktorch_tpu.ctl.elastic import (
+    ELASTIC_SECTION,
+    ElasticController,
+    round_robin_assign,
+)
+from sparktorch_tpu.ctl.proc import (
+    EXIT_FAILED,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    ProcessWorker,
+    spawn_worker,
+    worker_ctl_url,
+)
+from sparktorch_tpu.ctl.route import (
+    CTL_TOKEN_ENV,
+    CtlRefused,
+    CtlRegistry,
+    ctl_request,
+)
+
+__all__ = [
+    "ELASTIC_SECTION",
+    "ElasticController",
+    "round_robin_assign",
+    "EXIT_FAILED",
+    "EXIT_OK",
+    "EXIT_PREEMPTED",
+    "ProcessWorker",
+    "spawn_worker",
+    "worker_ctl_url",
+    "CTL_TOKEN_ENV",
+    "CtlRefused",
+    "CtlRegistry",
+    "ctl_request",
+]

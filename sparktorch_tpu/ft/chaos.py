@@ -97,6 +97,15 @@ class ChaosConfig:
     # name it.
     slow_replica_s: Mapping[Any, float] = dataclasses.field(
         default_factory=dict)
+    # rank -> step: deliver a raw SIGKILL to that rank's PROCESS
+    # worker once its heartbeat reports reaching the step — the
+    # NON-COOPERATIVE death the thread deployment can never exercise
+    # (no cancel event, no grace, a worker wedged on the GIL dies
+    # anyway). Fired at the 'ctl.process' site by the supervising
+    # handle's own liveness poll; one-shot per rank so the restarted
+    # worker's rerun survives.
+    kill_process_at: Mapping[int, int] = dataclasses.field(
+        default_factory=dict)
 
 
 class ChaosInjector:
@@ -121,6 +130,7 @@ class ChaosInjector:
         self._shard_kills_fired: set = set()
         self._replica_requests: Dict[str, int] = {}
         self._replica_kills_fired: set = set()
+        self._process_kills_fired: set = set()
 
     def _record(self, site: str, **ctx: Any) -> None:
         self.events.append({"site": site, **ctx})
@@ -203,6 +213,23 @@ class ChaosInjector:
                                      route=ctx.get("route"))
                         action["die"] = True
             return action or None
+        elif site == "ctl.process":
+            # Non-cooperative process kill: the handle's liveness poll
+            # asks "should this rank die NOW?" with the step its
+            # heartbeat last reported. None until the step is reached;
+            # one SIGKILL action per rank, ever (the restarted rerun
+            # must survive).
+            rank = ctx.get("rank")
+            at = cfg.kill_process_at.get(rank)
+            if at is not None:
+                step = ctx.get("step")
+                if step is not None and step >= at:
+                    with self._lock:
+                        if rank in self._process_kills_fired:
+                            return None
+                        self._process_kills_fired.add(rank)
+                        self._record(site, rank=rank, step=step)
+                    return {"sigkill": True}
         elif site == "serve.replica":
             # Same shape as 'fleet.shard': an optional straggler delay
             # plus a one-shot Nth-request kill, keyed by replica id.

@@ -24,11 +24,26 @@ the trainers and the param server share, so one ``/metrics`` scrape
 (or JSONL dump) tells the whole recovery story.
 
 Workers run as threads (the hogwild deployment inside ``train_async``)
-or real processes (gang ranks); the handle protocol is tiny on
-purpose. Restarted sync ranks resume from the latest finalized
+or real processes; the HANDLE CONTRACT is tiny on purpose — ``name``,
+``error`` (None until a failure is known), ``is_alive()``,
+``join(timeout)``, ``kill()`` — and has three implementations:
+:class:`ThreadWorker` (cooperative kill via a cancel Event),
+:class:`ProcessWorker` here (a bare ``multiprocessing.Process``
+terminate), and :class:`sparktorch_tpu.ctl.proc.ProcessWorker` (the
+control-plane one: spawned ``python -m sparktorch_tpu.ctl.worker``
+children, heartbeat-file liveness, and a ``kill()`` that escalates
+SIGTERM -> grace -> SIGKILL, so even a worker wedged on the GIL
+actually dies). Restarted sync ranks resume from the latest finalized
 checkpoint (auto-discovered via ``utils.checkpoint.latest_step``);
 restarted hogwild workers rejoin by pulling the current server version
 (their first pull is ``have_version=-1``).
+
+Budget exhaustion is pluggable: by default a worker that spends its
+restart budget fails the run (:class:`WorkerFailed`); a supervisor
+constructed with ``on_exhausted=`` can ABSORB the failure instead —
+the elastic controller's shrink path (:mod:`sparktorch_tpu.ctl.
+elastic`) redistributes the dead rank's work and the run continues in
+a smaller world.
 """
 
 from __future__ import annotations
@@ -157,11 +172,19 @@ class Supervisor:
     def __init__(self, policy: Optional[FtPolicy] = None,
                  telemetry=None, heartbeat_dir: Optional[str] = None,
                  exporter_url: Optional[str] = None,
+                 on_exhausted=None,
                  name: str = "supervisor"):
         self.policy = policy or FtPolicy()
         self.telemetry = telemetry or get_telemetry()
         self.heartbeat_dir = heartbeat_dir
         self.exporter_url = exporter_url
+        # ``on_exhausted(name, rank, error) -> bool``: called when a
+        # worker dies past its restart budget. True = the failure was
+        # ABSORBED (an elastic controller shrank the world and
+        # redistributed the work) — the worker is marked done and the
+        # run continues; False/None keeps the original fail-the-run
+        # behavior.
+        self.on_exhausted = on_exhausted
         self.name = name
         self._rng = self.policy.rng()
         self._workers: List[_Supervised] = []
@@ -224,10 +247,21 @@ class Supervisor:
         supervision of the other workers never pauses."""
         policy = self.policy.restart
         if w.restarts >= policy.max_restarts:
-            w.failed = w.failed or WorkerFailed(
+            err = WorkerFailed(
                 f"{w.name}: restart budget ({policy.max_restarts}) "
                 f"exhausted ({reason})"
             )
+            if self.on_exhausted is not None and self.on_exhausted(
+                    w.name, w.rank, err):
+                # Absorbed (elastic shrink): this worker's share moved
+                # elsewhere; it is done, not failed.
+                w.done = True
+                self.telemetry.counter("ft_budget_absorbed_total",
+                                       labels={"worker": w.name})
+                self.telemetry.event("ft_budget_absorbed", worker=w.name,
+                                     reason=reason)
+                return
+            w.failed = w.failed or err
             return
         delay = policy.delay_s(w.restarts, self._rng)
         w.detected_at = time.perf_counter()
@@ -241,6 +275,12 @@ class Supervisor:
 
     def _do_restart(self, w: _Supervised) -> None:
         attempt = w.restarts + 1
+        old = w.handle
+        if old is not None:
+            # Retire the replaced handle's on-disk residue (a ctl
+            # ProcessWorker's payload/url files); thread handles have
+            # no cleanup and are skipped.
+            getattr(old, "cleanup", lambda: None)()
         w.handle = w.start_fn(attempt)
         w.restarts = attempt
         w.preempting = False

@@ -54,12 +54,20 @@ class GangMetricsExporter:
 
     def __init__(self, heartbeat_dir: Optional[str] = None,
                  coordinator: Optional["GangCoordinator"] = None,
-                 telemetry=None, host: str = "127.0.0.1", port: int = 0):
+                 telemetry=None, host: str = "127.0.0.1", port: int = 0,
+                 ctl=None):
         self.heartbeat_dir = heartbeat_dir or os.environ.get(HEARTBEAT_DIR_ENV)
         self.coordinator = coordinator
         self.telemetry = telemetry
         self.host = host
         self.port = port
+        # Control surface (``POST /ctl``): a :class:`sparktorch_tpu.
+        # ctl.CtlRegistry` (duck-typed — anything with ``check_token``
+        # and ``handle``) lets an elastic controller manage this
+        # process (kill/drain/resize verbs) over HTTP when it holds no
+        # local handle on it. None = the route answers 404 (the
+        # original read-only exporter).
+        self.ctl = ctl
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
 
@@ -150,6 +158,37 @@ class GangMetricsExporter:
                 else:
                     self._send(404)
 
+            def do_POST(self):
+                route = self.path.split("?", 1)[0]
+                if route != "/ctl" or exporter.ctl is None:
+                    self._send(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = _json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("ctl body must be an object")
+                except (ValueError, TypeError) as e:
+                    self._send(400, str(e).encode())
+                    return
+                if not exporter.ctl.check_token(
+                        self.headers.get("X-Ctl-Token")):
+                    self._send(403, b"bad ctl token")
+                    return
+                verb = body.get("verb")
+                args = body.get("args") or {}
+                try:
+                    result = exporter.ctl.handle(verb, args)
+                except KeyError:
+                    self._send(400, f"unknown verb {verb!r}".encode())
+                    return
+                except Exception as e:  # verb handlers are user code
+                    self._send(500, f"{type(e).__name__}: {e}".encode())
+                    return
+                self._send(200, _json.dumps(
+                    {"ok": True, "verb": verb, "result": result}).encode(),
+                    content_type="application/json")
+
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -191,6 +230,9 @@ def _lib():
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
     ]
     lib.gang_server_port.argtypes = [ctypes.c_void_p]
+    lib.gang_server_resize.restype = ctypes.c_long
+    lib.gang_server_resize.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.gang_server_world_size.argtypes = [ctypes.c_void_p]
     lib.gang_server_generation.restype = ctypes.c_long
     lib.gang_server_generation.argtypes = [ctypes.c_void_p]
     lib.gang_server_failed.argtypes = [ctypes.c_void_p]
@@ -288,27 +330,72 @@ class GangCoordinator:
         self.port = self._lib.gang_server_port(self._handle)
         self.world_size = world_size
         self.rejoin_grace_ms = rejoin_grace_ms
+        # Last-observed native state, snapshotted by stop() BEFORE the
+        # handle is freed: callers (the elastic bench's summary, a
+        # supervisor's post-mortem) read .generation/.failed after the
+        # run's finally-block stop, and passing the nulled handle into
+        # the native calls is a use-after-free (observed segfault).
+        self._final = {"failed": False, "dead_rank": -1,
+                       "generation": 0, "registered": 0}
 
     @property
     def failed(self) -> bool:
+        if not self._handle:
+            return self._final["failed"]
         return bool(self._lib.gang_server_failed(self._handle))
 
     @property
     def dead_rank(self) -> int:
+        if not self._handle:
+            return self._final["dead_rank"]
         return int(self._lib.gang_server_dead_rank(self._handle))
 
     @property
     def generation(self) -> int:
         """Bumped once per rejoin-after-failure episode; generation 0
         is the original gang."""
+        if not self._handle:
+            return self._final["generation"]
         return int(self._lib.gang_server_generation(self._handle))
 
     @property
     def registered(self) -> int:
+        if not self._handle:
+            return self._final["registered"]
         return int(self._lib.gang_server_registered(self._handle))
+
+    def resize(self, new_world_size: int) -> int:
+        """Elastic world resize: a membership event with the same
+        semantics as a rejoin-after-failure — the generation bumps,
+        membership/barrier state clears, parked barrier waiters are
+        released with an error, and every (surviving or new) rank must
+        re-register fresh into the new generation. The elastic
+        controller calls this when a rank exhausts its restart budget
+        (shrink: the world continues without it) or a new host joins
+        (grow). Returns the new generation."""
+        if new_world_size < 1:
+            raise ValueError(
+                f"world_size must be >= 1, got {new_world_size}")
+        if not self._handle:
+            raise RuntimeError("cannot resize a stopped coordinator")
+        gen = int(self._lib.gang_server_resize(self._handle,
+                                               int(new_world_size)))
+        if gen < 0:
+            raise RuntimeError("gang coordinator refused the resize")
+        self.world_size = int(new_world_size)
+        return gen
 
     def stop(self):
         if self._handle:
+            self._final = {
+                "failed": bool(self._lib.gang_server_failed(self._handle)),
+                "dead_rank": int(
+                    self._lib.gang_server_dead_rank(self._handle)),
+                "generation": int(
+                    self._lib.gang_server_generation(self._handle)),
+                "registered": int(
+                    self._lib.gang_server_registered(self._handle)),
+            }
             self._lib.gang_server_stop(self._handle)
             self._handle = None
 

@@ -48,6 +48,7 @@ import json
 import os
 import threading
 import time
+import urllib.error
 import urllib.request
 import zlib
 from typing import Any, Dict, List, Mapping, Optional, Tuple
@@ -119,6 +120,39 @@ def scrape_json(url: str, timeout: float = _SCRAPE_TIMEOUT) -> Any:
         return json.loads(body)
     except ValueError as e:
         raise ScrapeError(f"{url}: torn/invalid JSON: {e}") from e
+
+
+def post_json(url: str, payload: Mapping[str, Any],
+              timeout: float = _SCRAPE_TIMEOUT,
+              headers: Optional[Mapping[str, str]] = None) -> Any:
+    """POST a JSON document to a control route (``/ctl``) and parse
+    the JSON reply — the write-side twin of :func:`scrape_json`, kept
+    in obs/ so control traffic shares the same timeout/error taxonomy
+    the lint-obs scrape discipline enforces on readers. Raises
+    :class:`ScrapeError` on network failure or a non-JSON reply;
+    non-2xx statuses raise with the server's body in the message (a
+    403 bad-token or 400 unknown-verb reply is the diagnostic)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(dict(payload)).encode(), method="POST",
+        headers={"Content-Type": "application/json",
+                 **(dict(headers) if headers else {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = resp.read().decode("utf-8", errors="replace")
+            if resp.status < 200 or resp.status >= 300:
+                raise ScrapeError(f"{url}: HTTP {resp.status}: {body}")
+    except ScrapeError:
+        raise
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode("utf-8", errors="replace")
+        raise ScrapeError(f"{url}: HTTP {e.code}: {detail}") from e
+    except (OSError, ValueError) as e:
+        raise ScrapeError(f"{url}: {type(e).__name__}: {e}") from e
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise ScrapeError(f"{url}: torn/invalid JSON reply: {e}") from e
 
 
 def snapshot_histogram(snapshot: Mapping[str, Any], name: str,
@@ -210,7 +244,8 @@ class FleetCollector:
                  scrape_timeout_s: float = _SCRAPE_TIMEOUT,
                  poll_parallelism: int = 8,
                  poll_deadline_s: Optional[float] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ctl=None, ctl_token: Optional[str] = None):
         if not targets:
             raise ValueError("FleetCollector needs at least one target")
         self.run_id = run_id or mint_run_id("collector")
@@ -242,6 +277,16 @@ class FleetCollector:
         )
         self._scrape_pool = None
         self._poll_seq = -1  # sweep generation (stale-commit guard)
+        # Control plane: ``POST /ctl`` with a ``rank`` is FORWARDED to
+        # that rank's exporter (same route, token header passed
+        # through) — the controller talks to one address and the
+        # collector fans out, exactly like the read side. Without a
+        # rank, the verb dispatches to this collector's own registry
+        # (``ctl`` — e.g. the elastic controller's resize verb);
+        # ``ctl_token`` guards BOTH paths (None = unguarded, for
+        # loopback dev rigs).
+        self.ctl = ctl
+        self.ctl_token = ctl_token
         self.host = host
         self.port = port
         self._lock = threading.Lock()
@@ -588,6 +633,15 @@ class FleetCollector:
             "heartbeats": heartbeats,
             "xprof": gang_xprof,
         }
+        # Elastic control-plane state: when an ElasticController shares
+        # this collector's bus (bringup wires them together), its
+        # generation-tagged world document — current world size,
+        # members, and the shrink/grow/restart event history — rides
+        # /gang beside liveness, so one scrape answers both "who is
+        # alive" and "what did the controller do about it".
+        elastic = self.telemetry.get_section("elastic")
+        if isinstance(elastic, dict):
+            doc["elastic"] = elastic
         if rpc_doc:
             # Condensed per-request view: what an operator wants from
             # /gang is "which requests, how slow, bounded by what" —
@@ -677,6 +731,61 @@ class FleetCollector:
             },
         }
 
+    # -- control plane -----------------------------------------------------
+
+    def _check_ctl_token(self, token: Optional[str]) -> bool:
+        if self.ctl is not None:
+            return bool(self.ctl.check_token(token))
+        if self.ctl_token:
+            return token == self.ctl_token
+        return True  # unguarded (loopback dev rigs)
+
+    def _handle_ctl(self, body: Mapping[str, Any],
+                    token: Optional[str]) -> Tuple[int, Dict[str, Any]]:
+        """One ``POST /ctl`` request: with a ``rank``, forward the
+        verb to that rank's exporter (the collector is the control
+        fan-out exactly as it is the scrape fan-in — the controller
+        needs one address); without one, dispatch to this collector's
+        own registry (e.g. an elastic controller's ``resize``)."""
+        if not self._check_ctl_token(token):
+            return 403, {"ok": False, "error": "bad ctl token"}
+        verb = body.get("verb")
+        rank = body.get("rank")
+        args = body.get("args") or {}
+        labels = {"verb": str(verb)}
+        if rank is not None:
+            st = self._ranks.get(str(rank))
+            if st is None:
+                return 404, {"ok": False,
+                             "error": f"unknown rank {rank!r}"}
+            headers = {"X-Ctl-Token": token} if token else None
+            try:
+                reply = post_json(st.url + "/ctl",
+                                  {"verb": verb, "args": args},
+                                  timeout=self.scrape_timeout_s,
+                                  headers=headers)
+            except ScrapeError as e:
+                self.telemetry.counter("collector.ctl_forward_errors_total",
+                                       labels=labels)
+                return 502, {"ok": False, "rank": str(rank),
+                             "error": str(e)}
+            self.telemetry.counter("collector.ctl_forwards_total",
+                                   labels=labels)
+            return 200, {"ok": True, "rank": str(rank), "reply": reply}
+        if self.ctl is None:
+            return 404, {"ok": False,
+                         "error": "no collector-side ctl registry"}
+        try:
+            result = self.ctl.handle(verb, args)
+        except KeyError:
+            return 400, {"ok": False, "error": f"unknown verb {verb!r}"}
+        except Exception as e:  # verb handlers are user code
+            return 500, {"ok": False,
+                         "error": f"{type(e).__name__}: {e}"}
+        self.telemetry.counter("collector.ctl_requests_total",
+                               labels=labels)
+        return 200, {"ok": True, "verb": verb, "result": result}
+
     # -- HTTP surface ------------------------------------------------------
 
     def start(self, serve: bool = True,
@@ -730,6 +839,24 @@ class FleetCollector:
                             content_type="application/json")
                     else:
                         self._send(404)
+
+                def do_POST(self):
+                    route = self.path.split("?", 1)[0]
+                    if route != "/ctl":
+                        self._send(404)
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("ctl body must be an object")
+                    except (ValueError, TypeError) as e:
+                        self._send(400, str(e).encode())
+                        return
+                    token = self.headers.get("X-Ctl-Token")
+                    code, reply = collector._handle_ctl(body, token)
+                    self._send(code, json.dumps(reply).encode(),
+                               content_type="application/json")
 
             self._httpd = ThreadingHTTPServer((self.host, self.port),
                                               Handler)

@@ -96,6 +96,8 @@ def bringup_multihost(
     ft_policy=None,
     run_id: Optional[str] = None,
     telemetry=None,
+    controller=None,
+    ctl_port: int = 0,
 ):
     """Rendezvous the gang and initialize JAX's distributed runtime.
 
@@ -122,6 +124,21 @@ def bringup_multihost(
     (``telemetry=`` wires this rank's run-scoped bus through to the
     gang worker), so a fleet collector (:class:`obs.FleetCollector`)
     can join the per-rank streams into one gang timeline.
+
+    ``controller`` arms the elastic control plane end to end: pass
+    ``True`` (or a :class:`sparktorch_tpu.ctl.CtlRegistry`) and this
+    rank starts a :class:`~sparktorch_tpu.native.gang.
+    GangMetricsExporter` (on ``ctl_port``; 0 = ephemeral) serving its
+    metrics/heartbeats PLUS ``POST /ctl`` with ``kill``/``drain``
+    verbs — so an :class:`sparktorch_tpu.ctl.ElasticController` (or
+    its collector fan-out) can manage this rank with no local process
+    handle. The exporter rides the returned worker as
+    ``worker.ctl_exporter`` (its ``.url`` is what you register with
+    the controller/collector); ``drain`` sets
+    ``worker.drain_requested``, which training loops may poll for a
+    graceful world change; ``kill`` hard-exits the process (reply
+    first, then ``os._exit`` — the controller's restart/resize path
+    takes it from there).
     """
     if world_size <= 1:
         return None, None
@@ -176,4 +193,25 @@ def bringup_multihost(
         process_id=rank,
     )
     register_gang_worker(worker)
+    if controller:
+        import threading as _threading
+
+        from sparktorch_tpu.ctl.route import CtlRegistry
+        from sparktorch_tpu.ctl.worker import _hard_exit_soon
+        from sparktorch_tpu.native.gang import GangMetricsExporter
+
+        ctl = CtlRegistry() if controller is True else controller
+        drain = _threading.Event()
+        worker.drain_requested = drain
+        # kill: reply-then-die (the 200 must reach the controller's
+        # socket before the process vanishes, or a successful kill
+        # reads as a transport error and gets retried at a corpse).
+        ctl.register("kill", lambda code=86: _hard_exit_soon(int(code)))
+        ctl.register("drain", lambda: (drain.set(), True)[1])
+        ctl.register("ping", lambda: {"rank": rank, "pid": os.getpid(),
+                                      "addr": my_addr})
+        worker.ctl_exporter = GangMetricsExporter(
+            coordinator=coord, telemetry=telemetry, port=ctl_port,
+            ctl=ctl,
+        ).start()
     return coord, worker

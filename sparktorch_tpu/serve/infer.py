@@ -634,3 +634,73 @@ class WeightPuller:
                 self.telemetry.counter("serve.weight_pull_errors_total",
                                        labels=self._labels)
             self._stop.wait(self.poll_s)
+
+
+# ---------------------------------------------------------------------------
+# Process entry point
+# ---------------------------------------------------------------------------
+
+
+def run_replica_server(torch_obj, replica_id="0",
+                       server_url: Optional[str] = None,
+                       seed: int = 0,
+                       buckets: Sequence[int] = DEFAULT_BUCKETS,
+                       max_queue_rows: int = 256,
+                       pull_poll_s: float = 0.05,
+                       pull_quant: Optional[str] = None,
+                       heartbeat_interval_s: float = 1.0,
+                       ctx=None) -> Dict[str, int]:
+    """ONE inference replica as a standalone process — the serving
+    twin of :func:`sparktorch_tpu.serve.fleet.run_shard_server`,
+    runnable under ``python -m sparktorch_tpu.ctl.worker`` with
+    ``kind='replica_server'``.
+
+    The replica initializes deterministically from ``(torch_obj,
+    seed)`` and — when ``server_url`` names a training param server,
+    fleet gateway, or anything serving the pull wire — runs a
+    :class:`WeightPuller` so a live training run refreshes this
+    process's weights continuously (the ft-supervised, elastically
+    resized serving fleet). Liveness rides the ctl context's
+    heartbeat (step = batches executed), so the controller's stall
+    and death policies apply unchanged. Blocks until the context's
+    cancel event (SIGTERM under the ctl entry).
+
+    Request ingress is the in-process ``submit`` surface; the remote
+    ``/infer`` HTTP frontend is the ROADMAP's filed follow-up — this
+    entry is the process-isolation + supervision + live-weights half
+    of "replicas as real processes/hosts".
+    """
+    import jax
+
+    from sparktorch_tpu.utils.serde import deserialize_model
+
+    spec = deserialize_model(torch_obj)
+    variables = dict(spec.init_params(jax.random.key(seed)))
+    params = variables.pop("params", variables)
+    telemetry = getattr(ctx, "telemetry", None)
+    replica = InferenceReplica(
+        spec.make_module(), params, model_state=variables or None,
+        replica_id=replica_id, buckets=buckets,
+        max_queue_rows=max_queue_rows, telemetry=telemetry,
+    )
+    puller = None
+    if server_url:
+        from sparktorch_tpu.net.transport import BinaryTransport
+
+        puller = WeightPuller(
+            replica, BinaryTransport(server_url, quant=pull_quant),
+            poll_s=pull_poll_s, telemetry=telemetry,
+        ).start()
+    cancel = getattr(ctx, "cancel", None) or threading.Event()
+    hb = getattr(ctx, "heartbeat", None)
+    try:
+        while not cancel.wait(heartbeat_interval_s):
+            if hb is not None:
+                hb.notify_step(replica._batches)
+    finally:
+        if puller is not None:
+            puller.stop()
+        replica.stop()
+    return {"replica_id": str(replica_id),
+            "batches": int(replica._batches),
+            "params_version": int(replica.params_version)}

@@ -835,3 +835,119 @@ def train_async(
         if http is not None:
             http.stop()
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Process entry point
+# ---------------------------------------------------------------------------
+
+
+def run_hogwild_worker(torch_obj, url: str, data,
+                       labels=None, iters: int = 10,
+                       mini_batch: Optional[int] = None,
+                       push_every: int = 1, seed: int = 0,
+                       worker_id: int = 0, wire: str = "binary",
+                       quant: Optional[str] = None,
+                       compress: bool = True,
+                       records_path: Optional[str] = None,
+                       ctx=None) -> dict:
+    """ONE hogwild worker as a standalone process — the training third
+    of the ``run_shard_server``-shaped entry family, runnable under
+    ``python -m sparktorch_tpu.ctl.worker`` with
+    ``kind='hogwild_worker'``: pull/push against ``url`` (a param
+    server, or a fleet gateway for legacy-topology workers) with its
+    own process, GIL, and device context.
+
+    ``data`` is the worker's SHARD: arrays, an ``(x, y)`` tuple, or a
+    path to an ``.npz`` with ``x``/``y`` (how a driver ships shards to
+    spawned processes without dill-ing arrays through the payload).
+    Records flush ATOMICALLY at completion to ``records_path``
+    (tmp + rename): a killed attempt publishes nothing, so the
+    supervisor-restarted rerun keeps counts exact — the same
+    records-exactness contract the thread deployment pins. The ctl
+    context's cancel event preempts between windows
+    (:class:`~sparktorch_tpu.ft.supervisor.WorkerPreempted`), and its
+    heartbeat carries the iteration for skew/stall policies.
+    """
+    if isinstance(data, str):
+        loaded = np.load(data)
+        x, y = loaded["x"], loaded["y"]
+    elif isinstance(data, tuple) and labels is None:
+        x, y = data
+    else:
+        x, y = data, labels
+    spec = deserialize_model(torch_obj)
+    if spec.input_shape is None:
+        spec.input_shape = tuple(np.asarray(x).shape[1:])
+    module = spec.make_module()
+    variables = dict(spec.init_params(jax.random.key(seed)))
+    variables.pop("params", None)
+    model_state = variables or {}
+    grad_step = make_grad_step(module.apply, spec.loss_fn(),
+                               mini_batch=mini_batch)
+    grad_windows = make_grad_windows(module.apply, spec.loss_fn(),
+                                     mini_batch, push_every, iters)
+    if wire == "binary":
+        push_quant = quant if quant else ("bf16" if compress else None)
+        transport = BinaryTransport(url, quant=push_quant)
+    elif wire == "dill":
+        transport = HttpTransport(url, compress=compress)
+    else:
+        raise ValueError(f"unknown wire {wire!r}; use 'binary' or 'dill'")
+    device = jax.devices()[0]
+    shard = DataBatch(jnp.asarray(x), jnp.asarray(y),
+                      jnp.ones((np.asarray(x).shape[0],), jnp.float32))
+    records: List[dict] = []
+    errors: List[BaseException] = []
+    tele = getattr(ctx, "telemetry", None) or get_telemetry()
+    cancel = getattr(ctx, "cancel", None)
+    hb = getattr(ctx, "heartbeat", None)
+    if hb is not None:
+        # Mirror loop progress onto the heartbeat: _worker_loop's
+        # telemetry counters already track iters; the heartbeat step
+        # is what the supervisor's skew/stall policies read. The real
+        # cancel is captured under its own name BEFORE the rebind
+        # below — is_set() reading the closure's `cancel` would find
+        # the wrapper itself and recurse.
+        inner_cancel = cancel
+
+        class _HbCancel:
+            """Duck-typed cancel: the loop polls is_set() once per
+            window — piggyback the heartbeat step publish there."""
+
+            def is_set(_self) -> bool:
+                hb.notify_step(int(tele.snapshot().get("counters", {})
+                                   .get(f"hogwild.iters{{worker={worker_id}}}",
+                                        0)))
+                return (inner_cancel.is_set()
+                        if inner_cancel is not None else False)
+
+        cancel = _HbCancel()
+    try:
+        _worker_loop(worker_id, device, transport, grad_step,
+                     model_state, shard, None, iters, 0, False, seed,
+                     records, errors, push_every, None, grad_windows,
+                     None, tele, cancel)
+    finally:
+        close = getattr(transport, "close", None)
+        if close is not None:
+            try:
+                close()
+            except OSError:
+                pass
+    if errors:
+        raise errors[0]
+    if records_path:
+        from sparktorch_tpu.obs.sinks import write_jsonl
+        import os as _os
+        import tempfile as _tempfile
+
+        fd, tmp = _tempfile.mkstemp(
+            prefix=".hogwild_records.", suffix=".jsonl",
+            dir=_os.path.dirname(records_path) or ".")
+        _os.close(fd)
+        write_jsonl(tmp, records, append=False)
+        _os.replace(tmp, records_path)
+    return {"worker_id": worker_id, "iters": iters,
+            "records": len(records),
+            "final_loss": records[-1]["loss"] if records else None}
