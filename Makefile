@@ -7,7 +7,7 @@ PYTHON ?= python
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
 	bench-ps-fleet bench-tune bench-rpc-trace bench-serve \
-	bench-elastic cluster-up clean lint-obs
+	bench-elastic bench-obs-history cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -37,6 +37,12 @@ install:
 #   child_span / SpanContext.child / the from_* parsers), which is
 #   where sampling decisions, SLO forcing, and id entropy stay
 #   audited. Annotated exemptions like the urllib rule.
+# - no raw time.time() outside obs/: DURATION math must use
+#   time.perf_counter() (wall clock steps under NTP slew — a negative
+#   "latency" has bitten this repo), and genuine wall-clock TIMESTAMPS
+#   (event stamps, heartbeats, cross-process joins) go through the
+#   named helper obs.telemetry.wall_ts so the grep can tell the two
+#   apart. Annotated exemptions like the urllib rule.
 lint-obs:
 	@hits=$$(grep -rn --include='*.py' -E '^[[:space:]]*print\(' \
 		sparktorch_tpu/ | grep -v '^sparktorch_tpu/bench\.py:' \
@@ -78,6 +84,15 @@ lint-obs:
 		echo "lint-obs: span context minted outside obs/ (go through"; \
 		echo "obs.rpctrace tracer helpers — root_span/child_span/"; \
 		echo "SpanContext.child — or annotate 'lint-obs: ok (<why>)'):"; \
+		echo "$$hits"; exit 1; \
+	fi; \
+	hits=$$(grep -rn --include='*.py' -E 'time\.time\(' \
+		sparktorch_tpu/ | grep -v '^sparktorch_tpu/obs/' \
+		| grep -v 'lint-obs: ok'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: raw time.time() outside obs/ (durations use"; \
+		echo "time.perf_counter(); wall-clock timestamps go through"; \
+		echo "obs.telemetry.wall_ts, or annotate 'lint-obs: ok (<why>)'):"; \
 		echo "$$hits"; exit 1; \
 	fi; echo "lint-obs OK"
 
@@ -227,6 +242,24 @@ bench-ps-fleet:
 bench-elastic:
 	$(PYTHON) -m sparktorch_tpu.bench --config elastic_ctl \
 		--log benchmarks/bench_r08_elastic.jsonl
+
+# Metrics-history / SLO-alerting / flight-recorder gate: a seeded
+# slow-shard degradation must fire the sustained client-hop
+# (shard_pull_latency_s) p99 breach rule within its rule window
+# while an A/A control run fires
+# NOTHING; a seeded non-cooperative process-worker kill must produce a
+# postmortem bundle whose causal event window contains the kill's
+# ctl.* transition and the victim's last spans (recovered from the
+# collector's last-good scrape of the dead process's flight-recorder
+# ring); and the collector sweep with history+alerts enabled must stay
+# within 10% of a history-off sweep (SPARKTORCH_TPU_OBS_SWEEP_TOL) —
+# FAILS otherwise. The record is retained (--log) so the sweep-cost
+# drift gate arms against the WINDOWED median of prior rounds
+# (SPARKTORCH_TPU_OBS_DRIFT_TOL, relative, default 1.0). Runs on any
+# backend (JAX_PLATFORMS=cpu works).
+bench-obs-history:
+	$(PYTHON) -m sparktorch_tpu.bench --config obs_history \
+		--log benchmarks/bench_r09_obs.jsonl
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

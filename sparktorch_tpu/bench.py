@@ -42,6 +42,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from sparktorch_tpu.obs.telemetry import wall_ts
+
 # Measured reference proxy (examples/sec) for the MNIST-CNN workload:
 # torch-CPU forward+backward+Adam, batch 1024, on this machine — the
 # substrate the reference's own tests/CI train on (environment.yml
@@ -1123,7 +1125,7 @@ def bench_rpc_trace() -> dict:
                     pass
                 with mtr.child_span("decode", sp.ctx, kind="server"):
                     pass
-                mtr.record("queue_wait", sp.ctx, time.time(), 0.001,
+                mtr.record("queue_wait", sp.ctx, wall_ts(), 0.001,
                            kind="server")
                 with mtr.child_span("apply", sp.ctx, kind="server"):
                     pass
@@ -1761,19 +1763,18 @@ def bench_serve_online() -> dict:
     }
 
 
-def _prior_record(config: str, field: str,
-                  root: Optional[str] = None,
-                  mesh: Optional[str] = None) -> Optional[dict]:
-    """The most recent PRIOR round's record for ``config`` that
-    carries ``field`` — scanned from the retained round artifacts
+def _prior_records(config: str, field: str,
+                   root: Optional[str] = None,
+                   mesh: Optional[str] = None) -> List[dict]:
+    """Every PRIOR round's record for ``config`` carrying ``field``,
+    oldest first — scanned from the retained round artifacts
     (repo-root ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` and the
     ``benchmarks/*.jsonl`` logs). ``mesh`` restricts the scan to
     records captured under the SAME layout (or predating the mesh
     field): the SPARKTORCH_TPU_TRACE_MESH=auto knob means adjacent
     rounds can capture different layouts with legitimately different
     comm budgets, and the newest same-mesh prior — not the newest
-    prior outright — is the valid baseline. None when no (matching)
-    prior exists (first armed round: the drift gate skips cleanly)."""
+    prior outright — is the valid baseline."""
     import glob
     import os
     import re
@@ -1817,9 +1818,40 @@ def _prior_record(config: str, field: str,
             continue
         for rec in rows:
             _consider(rec, path)
-    if not candidates:
+    return [rec for _, rec in sorted(candidates, key=lambda c: c[0])]
+
+
+def _prior_record(config: str, field: str,
+                  root: Optional[str] = None,
+                  mesh: Optional[str] = None) -> Optional[dict]:
+    """The most recent prior record (see :func:`_prior_records`).
+    None when no (matching) prior exists — first armed round, the
+    drift gate skips cleanly."""
+    recs = _prior_records(config, field, root=root, mesh=mesh)
+    return recs[-1] if recs else None
+
+
+def _prior_window(config: str, field: str, k: int = 3,
+                  root: Optional[str] = None,
+                  mesh: Optional[str] = None) -> Optional[dict]:
+    """WINDOWED drift baseline: the median of ``field`` over the
+    newest ``k`` prior records, not the single newest one — the same
+    judgment the collector's history tier applies to live metrics,
+    applied to retained bench rounds. A drift gate comparing against
+    one record inherits that record's rig luck (this rig's serve
+    throughput breathes 2x hour to hour — see the PR 9 notes); the
+    windowed median absorbs one outlier round. None when no prior
+    exists."""
+    recs = _prior_records(config, field, root=root, mesh=mesh)[-max(1, k):]
+    if not recs:
         return None
-    return max(candidates, key=lambda c: c[0])[1]
+    values = [float(r[field]) for r in recs]
+    return {
+        "median": float(np.median(values)),
+        "n": len(values),
+        "values": [round(v, 6) for v in values],
+        "newest_ts": recs[-1].get("ts"),
+    }
 
 
 def _prior_comm_budget(config: str,
@@ -2858,8 +2890,8 @@ def bench_elastic_ctl(n_parts: int = 36, part_sleep_s: float = 0.4,
     def grower():
         # The rejoin: a NEW rank joins right after the shrink lands,
         # so the gate always sees shrink THEN grow in one run.
-        deadline = time.time() + 120.0
-        while time.time() < deadline and not ctl._stop.is_set():
+        deadline = time.perf_counter() + 120.0
+        while time.perf_counter() < deadline and not ctl._stop.is_set():
             if ctl._resizes["shrink"] >= 1:
                 ctl.grow(3, start_fn)
                 return
@@ -2985,6 +3017,424 @@ def bench_elastic_ctl(n_parts: int = 36, part_sleep_s: float = 0.4,
         "chaos_kills": len(kills_fired),
         "records_exact": True,
         "elastic_drift": drift,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+    }
+
+
+def bench_obs_history(n_pulls: int = 6, slow_delay_s: float = 0.5,
+                      for_sweeps: int = 3) -> dict:
+    """Metrics-history / SLO-alerting / flight-recorder gate
+    (``make bench-obs-history``) — FAILS (raises) unless all three
+    retained-observability claims hold end to end:
+
+    - **alerting is causal, not noisy**: against a live 2-shard fleet,
+      a seeded degradation (chaos ``slow_shard_s``) must fire the
+      sustained ``sharded.shard_pull_latency_s`` p99 breach rule (the
+      client hop — the server-side ``wire_latency_s`` can never see
+      the injected delay) within its rule window
+      (``for_sweeps`` + 2 sweeps of the first breach), exactly one
+      episode, visible in the collector's ``/gang`` ``alerts`` section
+      over HTTP — while an A/A CONTROL run (identical loop, no chaos)
+      fires nothing;
+    - **postmortems capture the causal window**: a seeded
+      NON-COOPERATIVE process-worker kill (chaos ``kill_process_at``)
+      must produce a ``postmortem_<ts>.json`` bundle whose event
+      window contains the kill's ``ctl.*`` transition AND the victim
+      rank's last spans (recovered from the collector's last-good
+      scrape of the dead process's flight-recorder ring), renderable
+      by ``timeline --postmortem``;
+    - **the memory tier is nearly free**: the collector sweep with
+      history + alerts enabled stays within 10%
+      (``SPARKTORCH_TPU_OBS_SWEEP_TOL``) of a history-off sweep —
+      medians over interleaved sweeps against the same targets, so
+      rig noise hits both legs.
+
+    A throughput-shaped drift gate arms once a prior record is
+    retained, judged against the WINDOWED median of the newest 3 prior
+    rounds (``_prior_window`` — the satellite that moves drift gates
+    off single records)."""
+    import io
+    import os
+    import tempfile
+    import contextlib
+
+    import jax
+
+    from sparktorch_tpu.ctl import ElasticController, spawn_worker
+    from sparktorch_tpu.ft import ChaosConfig, FtPolicy, RestartPolicy, inject
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.net.sharded import ShardedTransport
+    from sparktorch_tpu.obs import AlertRule, FleetCollector, Telemetry
+    from sparktorch_tpu.obs import timeline as _timeline
+    from sparktorch_tpu.obs.blackbox import read_postmortem
+    from sparktorch_tpu.obs.collector import scrape_json
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+    from sparktorch_tpu.serve.fleet import ParamServerFleet
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    t_start = time.perf_counter()
+    spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                     optimizer="sgd", optimizer_params={"lr": 1e-2},
+                     input_shape=(784,))
+    slow_shard = "1"
+    threshold_s = slow_delay_s * 0.4  # far above clean serve, far below delayed
+
+    def _alert_leg(chaos_cfg) -> dict:
+        """One fleet + collector + rule run; returns the alert story."""
+        leg_tele = Telemetry(run_id="bench_obs_alert")
+        fleet = ParamServerFleet(spec, n_shards=2,
+                                 telemetry=leg_tele).start()
+        # Client-observed hop latency, not the server-side
+        # wire_latency_s: the chaos delay (like a real network/queue
+        # straggler) lands BEFORE the serve handler's clock, on the
+        # client's shard hop — which is exactly the series a hot-shard
+        # rule must watch.
+        rules = [AlertRule(
+            name="hot_shard_p99",
+            metric="sharded.shard_pull_latency_s",
+            labels={"shard": slow_shard},
+            kind="sustained", field="p99", op=">",
+            threshold=threshold_s, for_sweeps=for_sweeps,
+        )]
+        collector = FleetCollector.for_fleet(fleet, poll_interval_s=0,
+                                             alert_rules=rules)
+        collector.start(poll_loop=False)
+        first_breach_sweep = None
+        fired_sweep = None
+        try:
+            transport = ShardedTransport(fleet, telemetry=leg_tele)
+            zeros = jax.tree.map(
+                lambda a: np.zeros_like(np.asarray(a)), fleet.assemble())
+            have = -1
+            ctx = (inject(chaos_cfg, telemetry=leg_tele) if chaos_cfg
+                   else contextlib.nullcontext())
+            with ctx:
+                for sweep in range(n_pulls):
+                    transport.push(zeros)
+                    fleet.drain()
+                    snap = transport.pull(have)
+                    if snap is not None:
+                        have = snap[0]
+                    collector.poll()
+                    state = collector.alerts.doc()["rules"]["hot_shard_p99"]
+                    if first_breach_sweep is None and state["streak"] > 0:
+                        first_breach_sweep = sweep
+                    if fired_sweep is None and state["state"] == "firing":
+                        fired_sweep = sweep
+            gang = scrape_json(f"{collector.url}/gang")
+            hist_rate = scrape_json(
+                f"{collector.url}/history?name=collector.scrapes_total"
+                f"&query=rate")
+            transport.close()
+            return {
+                "doc": collector.alerts.doc(),
+                "gang_alerts": gang.get("alerts") or {},
+                "first_breach_sweep": first_breach_sweep,
+                "fired_sweep": fired_sweep,
+                "history_rate_ok": hist_rate.get("value") is not None,
+            }
+        finally:
+            collector.stop()
+            fleet.stop()
+
+    with Telemetry(run_id="bench_obs").span("bench/alert_legs") as _sp_alerts:
+        control = _alert_leg(None)
+        chaotic = _alert_leg(ChaosConfig(
+            seed=7, slow_shard_s={slow_shard: slow_delay_s}))
+
+    # -- gates: A/A control silent, seeded breach fires in-window ------
+    ctl_rule = control["doc"]["rules"]["hot_shard_p99"]
+    if ctl_rule["episodes"] != 0 or control["gang_alerts"].get("active"):
+        raise AssertionError(
+            f"A/A control run fired alerts: {ctl_rule} "
+            f"(active {control['gang_alerts'].get('active')})")
+    hot_rule = chaotic["doc"]["rules"]["hot_shard_p99"]
+    if hot_rule["episodes"] != 1 or hot_rule["state"] != "firing":
+        raise AssertionError(
+            f"seeded degradation did not fire exactly one episode: "
+            f"{hot_rule}")
+    if chaotic["first_breach_sweep"] is None \
+            or chaotic["fired_sweep"] is None \
+            or (chaotic["fired_sweep"] - chaotic["first_breach_sweep"]
+                > for_sweeps + 1):
+        raise AssertionError(
+            f"alert missed its rule window: first breach sweep "
+            f"{chaotic['first_breach_sweep']}, fired sweep "
+            f"{chaotic['fired_sweep']} (for_sweeps={for_sweeps})")
+    if "hot_shard_p99" not in (chaotic["gang_alerts"].get("active") or []):
+        raise AssertionError(
+            f"/gang alerts section does not show the firing rule: "
+            f"{chaotic['gang_alerts']}")
+    if not (control["history_rate_ok"] and chaotic["history_rate_ok"]):
+        raise AssertionError("/history rate query answered null on a "
+                             "live collector")
+
+    # -- leg 2: seeded worker kill -> postmortem bundle ----------------
+    with Telemetry(run_id="bench_obs").span("bench/postmortem_leg") as _sp_pm:
+        tele = Telemetry(run_id="bench_obs_pm")
+        workdir = tempfile.mkdtemp(prefix="bench_obs_pm_")
+        out = os.path.join(workdir, "parts")
+        hb_dir = os.path.join(workdir, "hb")
+        pm_dir = os.path.join(workdir, "postmortems")
+        os.makedirs(out)
+        work = [f"part{i:02d}" for i in range(8)]
+
+        def completed(p):
+            return os.path.exists(os.path.join(out, p + ".done"))
+
+        workers = {}
+        # The chaos kill fires at rank 0's heartbeat step 2; the bundle
+        # gate needs the victim's spans in the collector's last-good
+        # snapshot first. Workers park before step 2 until this file
+        # appears — the bench writes it once the collector has scraped
+        # rank 0's blackbox ring, so a slow rank-1 spawn (the collector
+        # starts only after BOTH URLs publish) can't let the kill
+        # outrun the first scrape.
+        scrape_gate = os.path.join(workdir, "scrape.gate")
+
+        def start_fn(rank, attempt, generation, assignment):
+            def workfn(ctx, _parts=tuple(assignment), _rank=rank,
+                       _out=out, _gate=scrape_gate):
+                import os as _os
+                import time as _t
+
+                for i, p in enumerate(_parts):
+                    if ctx.should_stop():
+                        return
+                    if i == 2 and not _os.path.exists(_gate):
+                        hold = _t.perf_counter() + 30.0
+                        while (not _os.path.exists(_gate)
+                               and _t.perf_counter() < hold
+                               and not ctx.should_stop()):
+                            _t.sleep(0.05)
+                    ctx.notify_step(i)
+                    # The victim's last evidence: a per-partition span
+                    # on its own bus -> flight-recorder ring ->
+                    # /telemetry scrape -> collector last-good.
+                    with ctx.telemetry.span("work/partition", labels={
+                            "part": p}):
+                        path = _os.path.join(_out, p + ".done")
+                        if not _os.path.exists(path):
+                            tmp = path + f".tmp{_os.getpid()}"
+                            with open(tmp, "w") as f:
+                                f.write(f"{_rank}")
+                            _os.replace(tmp, path)
+                        _t.sleep(0.25)
+
+            w = spawn_worker(workfn, rank=rank, heartbeat_dir=hb_dir,
+                             name=f"rank{rank}", telemetry=tele,
+                             ctl_port=0)
+            workers[rank] = w
+            return w
+
+        policy = FtPolicy(restart=RestartPolicy(max_restarts=2,
+                                                backoff_base_s=0.05,
+                                                backoff_max_s=0.2), seed=0)
+        ctl = ElasticController(work, completed, policy=policy,
+                                telemetry=tele, min_world=1,
+                                postmortem_dir=pm_dir,
+                                name="bench_obs_pm")
+        ctl.add_rank(0, start_fn)
+        ctl.add_rank(1, start_fn)
+        collector = None
+        try:
+            with inject(ChaosConfig(seed=13, kill_process_at={0: 2}),
+                        telemetry=tele) as inj:
+                # Launch via run() in a thread? No: run() launches and
+                # supervises; the collector needs the workers' exporter
+                # URLs, which exist only after launch. Launch first via
+                # a short-lived controller thread would race — instead
+                # poll the URLs from the handles the start_fn records.
+                import threading as _threading
+
+                run_err = []
+
+                def _run():
+                    try:
+                        ctl.run(poll_interval_s=0.05, deadline_s=120.0)
+                    except BaseException as e:  # surfaced below
+                        run_err.append(e)
+
+                runner = _threading.Thread(target=_run, daemon=True)
+                runner.start()
+                deadline = time.perf_counter() + 30.0
+                urls = {}
+                while time.perf_counter() < deadline and len(urls) < 2:
+                    for rank, w in list(workers.items()):
+                        if rank not in urls:
+                            url = w.ctl_url(timeout_s=0.1)
+                            if url:
+                                urls[rank] = url
+                    time.sleep(0.05)
+                if len(urls) < 2:
+                    raise AssertionError(
+                        f"worker exporters never published URLs: {urls}")
+                collector = FleetCollector(urls, telemetry=tele,
+                                           poll_interval_s=0.1)
+                collector.start(poll_loop=True)
+                ctl.collector = collector
+                # Open the kill gate only after the victim's ring is in
+                # last-good — otherwise the bundle can miss its spans.
+                from sparktorch_tpu.obs.blackbox import (
+                    events_from_snapshot as _ring_events)
+                scraped = time.perf_counter() + 30.0
+                while time.perf_counter() < scraped:
+                    with collector._lock:
+                        st = collector._ranks.get("0")
+                        snap = st.snapshot if st is not None else None
+                    if snap and any(e.get("kind") == "span"
+                                    for e in _ring_events(snap)):
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise AssertionError(
+                        "collector never scraped rank 0's blackbox ring")
+                with open(scrape_gate + ".tmp", "w") as f:
+                    f.write("ok")
+                os.replace(scrape_gate + ".tmp", scrape_gate)
+                runner.join(timeout=120.0)
+                if runner.is_alive():
+                    raise AssertionError("postmortem leg run() hung")
+                if run_err:
+                    raise AssertionError(
+                        f"postmortem leg failed: {run_err[0]}")
+        finally:
+            if collector is not None:
+                collector.stop()
+        missing = [p for p in work if not completed(p)]
+        if missing:
+            raise AssertionError(f"partitions incomplete: {missing}")
+        kills = [e for e in inj.events if e["site"] == "ctl.process"]
+        if len(kills) != 1 or kills[0]["rank"] != 0:
+            raise AssertionError(f"chaos kill fired {kills} (want one "
+                                 f"SIGKILL on rank 0)")
+        bundles = sorted(os.listdir(pm_dir)) if os.path.isdir(pm_dir) else []
+        if not bundles:
+            raise AssertionError("no postmortem bundle written")
+        # The KILL's bundle is the first one (restart_scheduled fires
+        # postmortems in detection order).
+        bundle = read_postmortem(os.path.join(pm_dir, bundles[0]))
+        kinds = {str(e.get("kind")) for e in bundle["events"]}
+        if not kinds & {"ctl.restart_scheduled", "restart_scheduled"}:
+            raise AssertionError(
+                f"bundle window lacks the kill's ctl.* transition: "
+                f"{sorted(kinds)}")
+        victim_spans = [e for e in bundle["events"]
+                        if e.get("kind") == "span"
+                        and str(e.get("rank")) == "0"]
+        if not victim_spans:
+            raise AssertionError(
+                f"bundle window lacks the victim's last spans "
+                f"(kinds {sorted(kinds)})")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = _timeline.main(["--postmortem",
+                                 os.path.join(pm_dir, bundles[0])])
+        if rc != 0 or "postmortem:" not in buf.getvalue():
+            raise AssertionError(f"timeline --postmortem failed (rc={rc})")
+
+    # -- leg 3: sweep overhead with history+alerts vs history-off ------
+    with Telemetry(run_id="bench_obs").span("bench/overhead_leg") as _sp_ovr:
+        ovr_tele = Telemetry(run_id="bench_obs_ovr")
+        ovr_tele.counter("reqs_total", 10)
+        for _ in range(64):
+            ovr_tele.observe("lat_s", 0.01)
+        exporters = [GangMetricsExporter(telemetry=ovr_tele,
+                                         port=0).start()
+                     for _ in range(2)]
+        targets = {i: e.url for i, e in enumerate(exporters)}
+        rules = [AlertRule(name="ovr", metric="lat_s",
+                           labels={"rank": "0"}, kind="sustained",
+                           field="p99", threshold=1e9, for_sweeps=2)]
+        col_on = FleetCollector(targets, poll_interval_s=0,
+                                alert_rules=rules)
+        col_off = FleetCollector(targets, poll_interval_s=0,
+                                 history=False)
+        on_walls, off_walls = [], []
+        try:
+            for _ in range(4):  # warmup both paths
+                col_on.poll()
+                col_off.poll()
+            for i in range(60):
+                ovr_tele.counter("reqs_total")
+                ovr_tele.observe("lat_s", 0.01)
+                # Interleaved, order alternating: scheduler epochs hit
+                # both legs equally.
+                pair = ((col_on, on_walls), (col_off, off_walls))
+                for col, walls in (pair if i % 2 == 0
+                                   else reversed(pair)):
+                    t0 = time.perf_counter()
+                    col.poll()
+                    walls.append(time.perf_counter() - t0)
+        finally:
+            col_on.stop()
+            col_off.stop()
+            for e in exporters:
+                e.stop()
+        on_ms = float(np.median(on_walls)) * 1e3
+        off_ms = float(np.median(off_walls)) * 1e3
+        on_min_ms = float(np.min(on_walls)) * 1e3
+        off_min_ms = float(np.min(off_walls)) * 1e3
+        tol = float(os.environ.get("SPARKTORCH_TPU_OBS_SWEEP_TOL", "0.10"))
+        # Gate on MIN-of-sweeps, not the median: the sweep is a
+        # deterministic workload, so its min isolates the real cost
+        # while the median breathes ±1ms with this rig's cpu-share
+        # scheduler (measured A/B medians swinging -4% to +6% across
+        # runs of the SAME code — pure noise against a ~100µs true
+        # cost). 0.2ms absolute floor for timer/allocator jitter.
+        if on_min_ms > off_min_ms * (1.0 + tol) + 0.2:
+            raise AssertionError(
+                f"history+alerts sweep overhead past bound: min "
+                f"{on_min_ms:.3f}ms vs {off_min_ms:.3f}ms history-off "
+                f"(medians {on_ms:.3f}/{off_ms:.3f}ms; tol {tol:.0%} "
+                f"+ 0.2ms)")
+
+    # -- drift gate (windowed prior median, arms once retained) --------
+    tol = float(os.environ.get("SPARKTORCH_TPU_OBS_DRIFT_TOL", "1.0"))
+    prior = _prior_window("obs_history", "sweep_on_ms", k=3)
+    if prior is None:
+        drift = {"status": "no_prior_record", "tolerance": tol}
+    else:
+        drift = {
+            "status": "checked", "tolerance": tol,
+            "prior_median_ms": round(prior["median"], 3),
+            "prior_n": prior["n"],
+            "ratio": round(on_ms / max(prior["median"], 1e-9), 3),
+        }
+        if on_ms > prior["median"] * (1.0 + tol) + 1.0:
+            raise AssertionError(
+                f"history-on sweep regressed: {on_ms:.3f}ms vs prior "
+                f"windowed median {prior['median']:.3f}ms (past the "
+                f"{tol} relative tolerance + 1ms floor); drift: {drift}")
+
+    return {
+        "config": "obs_history", "unit": "ms (history-on sweep p50)",
+        "value": round(on_ms, 3),
+        "sweep_on_ms": round(on_ms, 3),
+        "sweep_off_ms": round(off_ms, 3),
+        "sweep_on_min_ms": round(on_min_ms, 3),
+        "sweep_off_min_ms": round(off_min_ms, 3),
+        "sweep_overhead_pct": round(100.0 * (on_min_ms - off_min_ms)
+                                    / max(off_min_ms, 1e-9), 2),
+        "alert": {
+            "threshold_s": threshold_s,
+            "for_sweeps": for_sweeps,
+            "control_episodes": ctl_rule["episodes"],
+            "chaos_episodes": hot_rule["episodes"],
+            "first_breach_sweep": chaotic["first_breach_sweep"],
+            "fired_sweep": chaotic["fired_sweep"],
+        },
+        "postmortem": {
+            "bundles": len(bundles),
+            "victim_spans": len(victim_spans),
+            "event_kinds": sorted(kinds)[:12],
+        },
+        "obs_drift": drift,
+        "phase_s": {
+            "alert_legs": round(_sp_alerts.duration_s, 3),
+            "postmortem_leg": round(_sp_pm.duration_s, 3),
+            "overhead_leg": round(_sp_ovr.duration_s, 3),
+        },
         "wall_s": round(time.perf_counter() - t_start, 2),
     }
 
@@ -3351,6 +3801,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_chaos": bench_hogwild_chaos,
     "hogwild_chaos_soak": bench_hogwild_chaos_soak,
     "elastic_ctl": bench_elastic_ctl,
+    "obs_history": bench_obs_history,
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "serve_online": bench_serve_online,
     "rpc_trace": bench_rpc_trace,

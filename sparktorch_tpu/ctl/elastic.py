@@ -51,7 +51,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from sparktorch_tpu.ft.policy import FtPolicy
 from sparktorch_tpu.ft.supervisor import WorkerFailed
 from sparktorch_tpu.obs.log import get_logger
-from sparktorch_tpu.obs.telemetry import get_telemetry
+from sparktorch_tpu.obs.telemetry import get_telemetry, wall_ts
 
 _LOG = get_logger("sparktorch_tpu.ctl.elastic")
 
@@ -117,7 +117,13 @@ class ElasticController:
                  ctl_token: Optional[str] = None,
                  min_world: int = 1,
                  drain_grace_s: float = 5.0,
-                 name: str = "elastic"):
+                 name: str = "elastic",
+                 alerts=None,
+                 on_scale_signal: Optional[Callable[[Dict[str, Any]],
+                                                    Any]] = None,
+                 postmortem_dir: Optional[str] = None,
+                 postmortem_window_s: float = 30.0,
+                 postmortem_min_interval_s: float = 0.0):
         self.work = list(work)
         self.completed_fn = completed_fn
         self.policy = policy or FtPolicy()
@@ -140,6 +146,108 @@ class ElasticController:
         self.history: List[Dict[str, Any]] = []
         self._resizes = {"shrink": 0, "grow": 0}
         self._gang_check_ts = 0.0
+        # SLO alerting consumer (ROADMAP item 3's "signals the
+        # collector already serves"): subscribing to an AlertManager
+        # turns every latched firing — a sustained hot-shard p99
+        # breach, a 429-rate burn — into a generation-tagged
+        # ``ctl.scale_signal`` this controller logs (and hands to the
+        # ``on_scale_signal`` policy hook, where a deployment attaches
+        # its split/drain/scale decision).
+        self.scale_signals: List[Dict[str, Any]] = []
+        self.on_scale_signal = on_scale_signal
+        self.alerts = alerts
+        # Flight-recorder postmortems: when a rank dies, is preempted,
+        # or an alert fires, fold every available blackbox ring (this
+        # bus's + each scraped rank's last-good) into one bundle under
+        # ``postmortem_dir``.
+        self.postmortem_dir = postmortem_dir
+        self.postmortem_window_s = float(postmortem_window_s)
+        self._postmortem_min_interval_s = float(postmortem_min_interval_s)
+        self._last_postmortem_ts = 0.0
+        self.postmortems: List[str] = []
+        if postmortem_dir:
+            from sparktorch_tpu.obs.blackbox import attach_recorder
+
+            attach_recorder(self.telemetry)
+        # Subscribe LAST: _on_alert runs on the collector's poll
+        # thread and reads the postmortem attributes above — a firing
+        # delivered mid-__init__ must not hit a half-built controller.
+        if alerts is not None:
+            alerts.subscribe(self._on_alert)
+
+    # -- alert consumption -------------------------------------------------
+
+    def _on_alert(self, event: Dict[str, Any]) -> None:
+        """AlertManager subscriber: a FIRED alert becomes a scale
+        signal (event + counter + the policy hook); a RESOLVED one
+        clears it. Runs on the collector's poll thread — must never
+        raise into the alert fan-out."""
+        what = event.get("event")
+        if what == "fired":
+            signal = {
+                "rule": event.get("alert"),
+                "rule_kind": event.get("rule_kind"),
+                "metric": event.get("metric"),
+                "labels": event.get("labels"),
+                "value": event.get("value"),
+                "episode": event.get("episode"),
+                "ts": event.get("ts"),
+            }
+            self.scale_signals.append(signal)
+            self.telemetry.counter("ctl.scale_signals_total",
+                                   labels={"rule": str(event.get("alert"))})
+            self._event("scale_signal", **signal)
+            _LOG.warning(
+                f"[sparktorch_tpu:ctl] scale signal from alert "
+                f"{event.get('alert')} (value={event.get('value')})")
+            if self.on_scale_signal is not None:
+                try:
+                    self.on_scale_signal(dict(event))
+                except Exception as e:  # noqa: BLE001 - policy hook
+                    _LOG.warning(f"[sparktorch_tpu:ctl] on_scale_signal "
+                                 f"raised: {type(e).__name__}: {e}")
+            self._write_postmortem(
+                f"alert {event.get('alert')} fired", rank=None)
+        elif what == "resolved":
+            self._event("scale_signal_cleared",
+                        rule=event.get("alert"),
+                        episode=event.get("episode"))
+
+    # -- postmortems -------------------------------------------------------
+
+    def _write_postmortem(self, reason: str,
+                          rank: Optional[int] = None) -> Optional[str]:
+        """Best-effort bundle write (death/preempt/alert triggers):
+        evidence collection must never take down supervision."""
+        if not self.postmortem_dir:
+            return None
+        now = time.perf_counter()
+        if self._postmortem_min_interval_s and \
+                now - self._last_postmortem_ts < \
+                self._postmortem_min_interval_s:
+            return None
+        self._last_postmortem_ts = now
+        from sparktorch_tpu.obs.blackbox import collect_postmortem
+
+        history = getattr(self.collector, "history", None)
+        try:
+            path = collect_postmortem(
+                self.postmortem_dir, reason,
+                telemetry=self.telemetry,
+                collector=self.collector,
+                history=history,
+                extra_events=self.history,
+                window_s=self.postmortem_window_s,
+                rank=rank,
+            )
+        except Exception as e:  # noqa: BLE001 - evidence is best-effort
+            self.telemetry.counter("ctl.postmortem_failures_total")
+            _LOG.warning(f"[sparktorch_tpu:ctl] postmortem write failed: "
+                         f"{type(e).__name__}: {e}")
+            return None
+        self.postmortems.append(path)
+        self.telemetry.counter("ctl.postmortems_total")
+        return path
 
     # -- membership --------------------------------------------------------
 
@@ -164,7 +272,12 @@ class ElasticController:
             self._pending_grow.append(_Member(int(rank), start_fn, ctl_url))
 
     def stop(self) -> None:
+        """Request shutdown; also the teardown for a controller that
+        never reached ``run()`` (whose finally is the other detach
+        path) — a retired controller must not stay subscribed as an
+        alert consumer."""
         self._stop.set()
+        self.detach_alerts()
 
     # -- views -------------------------------------------------------------
 
@@ -195,7 +308,10 @@ class ElasticController:
                     "remote": m.start_fn is None,
                     "exporter_gone": m.exporter_gone,
                 }
-                for m in self._members.values()
+                # list() snapshot: _on_alert publishes from the
+                # collector's poll thread while a resize mutates the
+                # member table on the run thread.
+                for m in list(self._members.values())
             },
             "work": {"total": len(self.work),
                      "pending": len(self.pending_work())},
@@ -206,7 +322,7 @@ class ElasticController:
 
     def _event(self, kind: str, **fields: Any) -> None:
         rec = {"kind": kind, "generation": self.generation,
-               "world_size": self.world_size(), "ts": time.time(),
+               "world_size": self.world_size(), "ts": wall_ts(),
                **fields}
         self.history.append(rec)
         self.telemetry.event(f"ctl.{kind}", **{k: v for k, v in rec.items()
@@ -259,6 +375,10 @@ class ElasticController:
         )
         self._event("restart_scheduled", rank=m.rank, reason=reason,
                     delay_s=delay)
+        # The death is the postmortem trigger: the bundle's window
+        # closes AFTER this transition landed, so the restart_scheduled
+        # event (and the victim's last scraped ring) are inside it.
+        self._write_postmortem(f"rank {m.rank} {reason}", rank=m.rank)
         return True
 
     def _do_restart(self, m: _Member) -> None:
@@ -352,6 +472,8 @@ class ElasticController:
             f"world {self.world_size() + 1} -> {self.world_size()}"
         )
         self._resize("shrink", m.rank)
+        self._write_postmortem(f"world shrunk around rank {m.rank} "
+                               f"({reason})", rank=m.rank)
 
     # -- collector-driven supervision --------------------------------------
 
@@ -431,6 +553,9 @@ class ElasticController:
                     self._event("stall_preempt", rank=m.rank,
                                 hb_age_s=hb_age)
                     m.handle.kill()
+                    self._write_postmortem(
+                        f"rank {m.rank} stall-preempted "
+                        f"(hb age {hb_age:.1f}s)", rank=m.rank)
                 elif m.start_fn is None:
                     # Remote rank, silent past the deadline, nothing
                     # to relaunch: the world must shrink around it.
@@ -439,13 +564,32 @@ class ElasticController:
 
     # -- main loop ---------------------------------------------------------
 
+    def detach_alerts(self) -> None:
+        """Stop consuming alert firings (idempotent). A finished or
+        retired controller must not keep turning alerts into scale
+        signals and postmortem bundles — the AlertManager would
+        otherwise hold it (and its buses) alive forever."""
+        alerts, self.alerts = self.alerts, None
+        if alerts is not None:
+            alerts.unsubscribe(self._on_alert)
+
     def run(self, poll_interval_s: float = 0.05,
             deadline_s: Optional[float] = None,
             gang_check_interval_s: float = 0.5) -> Dict[str, Any]:
         """Launch every member and supervise until the WORK is done
         (every partition complete) and no member is mid-restart.
         Returns the run summary; raises :class:`WorkerFailed` only
-        when the world can no longer shrink (below ``min_world``)."""
+        when the world can no longer shrink (below ``min_world``).
+        Either way the controller retires as an alert consumer."""
+        try:
+            return self._run_supervise(poll_interval_s, deadline_s,
+                                       gang_check_interval_s)
+        finally:
+            self.detach_alerts()
+
+    def _run_supervise(self, poll_interval_s: float,
+                       deadline_s: Optional[float],
+                       gang_check_interval_s: float) -> Dict[str, Any]:
         t0 = time.perf_counter()
         if not self._members:
             raise ValueError(f"{self.name}: no members added")

@@ -46,6 +46,7 @@ import dill
 
 from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.obs.log import get_logger
+from sparktorch_tpu.obs.telemetry import wall_ts
 
 _LOG = get_logger("sparktorch_tpu.ctl.proc")
 
@@ -155,7 +156,7 @@ class ProcessWorker:
         rec = self.heartbeat_record()
         if not rec or rec.get("ts") is None:
             return None
-        return max(0.0, (now if now is not None else time.time())
+        return max(0.0, (now if now is not None else wall_ts())
                    - float(rec["ts"]))
 
     # -- preemption --------------------------------------------------------

@@ -91,6 +91,14 @@ def build_context(payload: Dict[str, Any]) -> WorkerContext:
 
     telemetry = Telemetry(run_id=os.environ.get(
         "SPARKTORCH_TPU_RUN_ID", f"ctl-{name}"))
+    # Every process worker keeps a flight recorder: its recent spans
+    # and events ride the /telemetry scrape as the ``blackbox``
+    # section, so the collector's last-good snapshot of a rank that
+    # then dies still holds the victim's final ring — the evidence a
+    # postmortem bundle is assembled from.
+    from sparktorch_tpu.obs.blackbox import attach_recorder
+
+    recorder = attach_recorder(telemetry)
     if hb_dir and rank is not None:
         from sparktorch_tpu.obs import HeartbeatEmitter
 
@@ -122,6 +130,7 @@ def build_context(payload: Dict[str, Any]) -> WorkerContext:
     ctx = WorkerContext(name, rank, cancel, heartbeat=heartbeat,
                         telemetry=telemetry, ctl=ctl)
     ctx._exporter = exporter  # kept alive for the process lifetime
+    ctx._recorder = recorder
     return ctx
 
 

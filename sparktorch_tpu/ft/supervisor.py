@@ -173,11 +173,26 @@ class Supervisor:
                  telemetry=None, heartbeat_dir: Optional[str] = None,
                  exporter_url: Optional[str] = None,
                  on_exhausted=None,
-                 name: str = "supervisor"):
+                 name: str = "supervisor",
+                 postmortem_dir: Optional[str] = None,
+                 postmortem_window_s: float = 30.0,
+                 collector=None):
         self.policy = policy or FtPolicy()
         self.telemetry = telemetry or get_telemetry()
         self.heartbeat_dir = heartbeat_dir
         self.exporter_url = exporter_url
+        # Flight-recorder postmortems: with a ``postmortem_dir``, every
+        # detected death/preemption folds the available blackbox rings
+        # (this bus's, plus each scraped rank's last-good when a
+        # ``collector`` is attached) into one bundle — the evidence of
+        # WHY a worker died no longer dies with its process.
+        self.postmortem_dir = postmortem_dir
+        self.postmortem_window_s = float(postmortem_window_s)
+        self.collector = collector
+        if postmortem_dir:
+            from sparktorch_tpu.obs.blackbox import attach_recorder
+
+            attach_recorder(self.telemetry)
         # ``on_exhausted(name, rank, error) -> bool``: called when a
         # worker dies past its restart budget. True = the failure was
         # ABSORBED (an elastic controller shrank the world and
@@ -240,11 +255,37 @@ class Supervisor:
 
     # -- policy application ------------------------------------------------
 
+    def _postmortem(self, reason: str, worker: Optional[str] = None,
+                    rank: Optional[int] = None) -> None:
+        """Best-effort bundle write on a detected death/preemption:
+        evidence must never take supervision down with it."""
+        if not self.postmortem_dir:
+            return
+        from sparktorch_tpu.obs.blackbox import collect_postmortem
+
+        try:
+            collect_postmortem(
+                self.postmortem_dir,
+                f"{worker or self.name}: {reason}",
+                telemetry=self.telemetry,
+                collector=self.collector,
+                history=getattr(self.collector, "history", None),
+                window_s=self.postmortem_window_s,
+                rank=rank,
+            )
+            self.telemetry.counter("ft_postmortems_total")
+        except Exception as e:  # noqa: BLE001 - best-effort evidence
+            self.telemetry.counter("ft_postmortem_failures_total")
+            self._log.warning(
+                f"[sparktorch_tpu:ft] postmortem write failed: "
+                f"{type(e).__name__}: {e}")
+
     def _schedule_restart(self, w: _Supervised, reason: str) -> None:
         """Death detected: either spend a restart slot (schedule the
         relaunch for after the backoff) or fail the worker for good.
         The backoff is a TIMESTAMP the poll loop checks, not a sleep —
         supervision of the other workers never pauses."""
+        self._postmortem(reason, worker=w.name, rank=w.rank)
         policy = self.policy.restart
         if w.restarts >= policy.max_restarts:
             err = WorkerFailed(

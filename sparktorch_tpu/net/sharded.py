@@ -414,6 +414,23 @@ class ShardedTransport:
 
     def _pull_shard(self, client: _ShardClient,
                     trace_parent=None) -> Optional[dict]:
+        # Client-observed per-shard hop latency, as a HISTOGRAM: this
+        # is where a straggling shard actually shows (server-side
+        # wire_latency_s times the handler, not the wire — a
+        # network/queueing delay lands here and only here), which
+        # makes it the series the collector's hot-shard alert rules
+        # watch for sustained p99 breaches.
+        hop_t0 = time.perf_counter()
+        try:
+            return self._pull_shard_inner(client, trace_parent)
+        finally:
+            if self.telemetry is not None:
+                self.telemetry.observe("sharded.shard_pull_latency_s",
+                                       time.perf_counter() - hop_t0,
+                                       labels={"shard": client.sid})
+
+    def _pull_shard_inner(self, client: _ShardClient,
+                          trace_parent=None) -> Optional[dict]:
         with self._tracer().child_span("shard_pull", trace_parent,
                                        kind="client",
                                        shard=client.sid) as tsp:

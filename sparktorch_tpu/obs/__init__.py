@@ -14,6 +14,15 @@ from sparktorch_tpu.obs.telemetry import (
     format_key,
     get_telemetry,
     set_telemetry,
+    wall_ts,
+)
+from sparktorch_tpu.obs.history import MetricsHistory
+from sparktorch_tpu.obs.alerts import AlertManager, AlertRule
+from sparktorch_tpu.obs.blackbox import (
+    FlightRecorder,
+    attach_recorder,
+    collect_postmortem,
+    read_postmortem,
 )
 from sparktorch_tpu.obs.sinks import JsonlSink, read_jsonl, write_jsonl
 from sparktorch_tpu.obs.prom import (
@@ -61,6 +70,14 @@ __all__ = [
     "format_key",
     "get_telemetry",
     "set_telemetry",
+    "wall_ts",
+    "MetricsHistory",
+    "AlertManager",
+    "AlertRule",
+    "FlightRecorder",
+    "attach_recorder",
+    "collect_postmortem",
+    "read_postmortem",
     "JsonlSink",
     "read_jsonl",
     "write_jsonl",

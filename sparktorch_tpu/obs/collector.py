@@ -245,11 +245,50 @@ class FleetCollector:
                  poll_parallelism: int = 8,
                  poll_deadline_s: Optional[float] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 ctl=None, ctl_token: Optional[str] = None):
+                 ctl=None, ctl_token: Optional[str] = None,
+                 history=True,
+                 history_retention: Optional[int] = None,
+                 history_spill_jsonl: Optional[str] = None,
+                 alert_rules=None):
         if not targets:
             raise ValueError("FleetCollector needs at least one target")
         self.run_id = run_id or mint_run_id("collector")
         self.telemetry = telemetry or Telemetry(run_id=self.run_id)
+        # Retained history: every poll sweep appends the merged series
+        # into bounded per-series rings (obs.history.MetricsHistory),
+        # served back as derived queries on ``GET /history`` and as
+        # the substrate the alert rules judge. ``history=False`` turns
+        # the tier off (the bench's overhead control leg); a
+        # MetricsHistory instance is adopted as-is.
+        from sparktorch_tpu.obs.history import DEFAULT_RETENTION, MetricsHistory
+
+        if history is True:
+            self.history: Optional[MetricsHistory] = MetricsHistory(
+                retention=history_retention or DEFAULT_RETENTION,
+                spill_jsonl=history_spill_jsonl)
+        elif history:
+            self.history = history
+        else:
+            self.history = None
+        # Declarative SLO/threshold alerting over the history
+        # (obs.alerts): rules evaluate once per sweep; latched,
+        # episode-counted transitions land on the bus, in the JSONL
+        # sink, and in /gang's ``alerts`` section.
+        self.alerts = None
+        if alert_rules:
+            if self.history is None:
+                raise ValueError("alert_rules need history enabled")
+            from sparktorch_tpu.obs.alerts import AlertManager
+
+            self.alerts = (alert_rules
+                           if isinstance(alert_rules, AlertManager)
+                           else AlertManager(self.history, alert_rules,
+                                             telemetry=self.telemetry))
+        # One atomic (sig, history) pair like _fallback_cache — two
+        # separately-assigned attributes can tear under the threading
+        # HTTP server and re-serve a reconstruction staler than the file.
+        self._fallback_history_cache: Optional[
+            Tuple[Tuple[int, int], MetricsHistory]] = None
         self._ranks: Dict[str, _RankState] = {
             str(r): _RankState(url) for r, url in targets.items()
         }
@@ -417,6 +456,11 @@ class FleetCollector:
         self._merge_xprof()
         self._stitch_rpc()
         merged = self.merged_snapshot()
+        alert_events: List[Dict[str, Any]] = []
+        if self.history is not None:
+            self.history.append(merged)
+            if self.alerts is not None:
+                alert_events = self.alerts.evaluate(ts=merged.get("ts"))
         if self.jsonl_path:
             from sparktorch_tpu.obs.sinks import write_jsonl
 
@@ -427,9 +471,15 @@ class FleetCollector:
                 # tailing this file must be able to serve the
                 # straggler/step-skew view, which is exactly what an
                 # operator wants DURING the outage HA mode covers.
+                # Alert transitions land in the sink as their own
+                # records BEFORE the snapshot: a `timeline --follow`
+                # tail renders the firing the moment it happens, and
+                # the HA fallback secondary replays the same episodes.
                 write_jsonl(self.jsonl_path,
-                            [{"kind": "gang_snapshot", **merged,
-                              "heartbeats": self._merged_heartbeats()}],
+                            [{"kind": f"alert.{e['event']}", **e}
+                             for e in alert_events]
+                            + [{"kind": "gang_snapshot", **merged,
+                                "heartbeats": self._merged_heartbeats()}],
                             append=True)
             except OSError as e:
                 _LOG.warning(
@@ -642,6 +692,14 @@ class FleetCollector:
         elastic = self.telemetry.get_section("elastic")
         if isinstance(elastic, dict):
             doc["elastic"] = elastic
+        # The judgment layer rides the same scrape: what the collector
+        # is worried about (alerts) and how much it remembers
+        # (history shape) — one /gang answers liveness, control-plane
+        # state, AND the SLO verdicts.
+        if self.alerts is not None:
+            doc["alerts"] = self.alerts.doc()
+        if self.history is not None:
+            doc["history"] = self.history.describe()
         if rpc_doc:
             # Condensed per-request view: what an operator wants from
             # /gang is "which requests, how slow, bounded by what" —
@@ -730,6 +788,89 @@ class FleetCollector:
                 ],
             },
         }
+
+    # -- history serving ---------------------------------------------------
+
+    def _history_for_serving(self):
+        """The history ``GET /history`` answers from: this collector's
+        own rings normally; in HA tail mode (never scraped, peer sink
+        configured) a history RECONSTRUCTED from the peer's JSONL —
+        the fallback secondary answers windowed queries, not just the
+        newest snapshot. The reconstruction is cached on the file's
+        (size, mtime) signature like the fallback gang view."""
+        live = self.history
+        if live is not None and live.sweeps > 0:
+            return live
+        if not self.fallback_jsonl:
+            return live
+        with self._lock:
+            never_scraped = not any(st.scrapes for st in
+                                    self._ranks.values())
+        if not never_scraped:
+            return live
+        import os as _os
+
+        from sparktorch_tpu.obs.history import (DEFAULT_RETENTION,
+                                                MetricsHistory)
+
+        try:
+            st = _os.stat(self.fallback_jsonl)
+            sig = (st.st_size, st.st_mtime_ns)
+        except OSError:
+            return live
+        cached = self._fallback_history_cache
+        if cached is None or cached[0] != sig:
+            try:
+                rebuilt = MetricsHistory.from_jsonl(
+                    self.fallback_jsonl,
+                    retention=(live.retention if live is not None
+                               else DEFAULT_RETENTION))
+            except OSError as e:
+                _LOG.warning(
+                    f"[sparktorch_tpu:collector] fallback history "
+                    f"{self.fallback_jsonl!r} unreadable: {e}")
+                return live
+            cached = (sig, rebuilt)
+            self._fallback_history_cache = cached
+            self.telemetry.counter("collector.fallback_history_builds_total")
+        return cached[1]
+
+    def _handle_history(self, params: Mapping[str, Any]
+                        ) -> Tuple[int, Dict[str, Any]]:
+        """One ``GET /history`` request (params = parsed query string,
+        one value per key). No ``name`` -> the describe block + series
+        list; with one -> the named derived query."""
+        from sparktorch_tpu.obs.history import parse_labels
+
+        history = self._history_for_serving()
+        if history is None:
+            return 404, {"ok": False, "error": "history tier disabled"}
+        cached = self._fallback_history_cache
+        source = ("fallback_jsonl"
+                  if cached is not None and history is cached[1]
+                  else "live")
+        name = params.get("name")
+        if not name:
+            doc = history.describe()
+            doc["series"] = history.series_names()
+            doc["source"] = source
+            return 200, doc
+        try:
+            doc = history.query(
+                params.get("query") or "series",
+                str(name),
+                labels=parse_labels(params.get("labels")),
+                window_s=(float(params["window_s"])
+                          if params.get("window_s") else None),
+                q=float(params["q"]) if params.get("q") else None,
+                field=params.get("field") or None,
+                since_ts=(float(params["since_ts"])
+                          if params.get("since_ts") else None),
+            )
+        except ValueError as e:
+            return 400, {"ok": False, "error": str(e)}
+        doc["source"] = source
+        return 200, doc
 
     # -- control plane -----------------------------------------------------
 
@@ -824,6 +965,14 @@ class FleetCollector:
                     route = self.path.split("?", 1)[0]
                     if route == "/":
                         self._send(200, b"sparktorch-tpu fleet collector")
+                    elif route == "/history":
+                        from urllib.parse import parse_qs
+
+                        qs = parse_qs(self.path.partition("?")[2])
+                        params = {k: v[0] for k, v in qs.items() if v}
+                        code, doc = collector._handle_history(params)
+                        self._send(code, json.dumps(doc).encode(),
+                                   content_type="application/json")
                     elif route == "/gang":
                         self._send(200,
                                    json.dumps(collector.gang_view()).encode(),

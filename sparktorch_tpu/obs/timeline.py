@@ -299,6 +299,209 @@ def _rpc_from_jsonl(records: List[Dict[str, Any]]
 
 
 # ---------------------------------------------------------------------------
+# Postmortem rendering (flight-recorder bundles)
+# ---------------------------------------------------------------------------
+
+
+def render_postmortem_report(doc: Dict[str, Any], top: int = 40) -> str:
+    """One terminal page from a postmortem bundle (``obs.blackbox.
+    collect_postmortem`` output): the header (reason, rank, world),
+    the causal event window offset on the trigger's clock (negative =
+    before the death), the biggest metric deltas of the last good
+    interval, and the stitched request traces' verdicts."""
+    trigger = float(doc.get("ts") or 0.0)
+    lines = [
+        f"postmortem: {doc.get('reason', '?')}"
+        + (f"   rank {doc['rank']}" if doc.get("rank") is not None else ""),
+        f"trigger ts: {trigger:.3f}   window: {doc.get('window_s')}s"
+        f"   events: {doc.get('n_events', 0)}"
+        + (f"   run: {doc['run_id']}" if doc.get("run_id") else ""),
+    ]
+    world = doc.get("world")
+    if isinstance(world, dict):
+        members = world.get("members") or {}
+        states = ",".join(f"{r}:{m.get('state')}"
+                          for r, m in sorted(members.items()))
+        lines.append(
+            f"world: generation {world.get('generation')}, "
+            f"size {world.get('world_size')}"
+            + (f"   members [{states}]" if states else ""))
+    hb = doc.get("heartbeats")
+    if isinstance(hb, dict) and hb.get("ranks"):
+        lines.append(
+            f"heartbeats: {len(hb.get('alive') or [])}/"
+            f"{hb.get('n_ranks')} alive"
+            + (f", step skew {hb['step_skew']}"
+               if hb.get("step_skew") is not None else ""))
+    events = list(doc.get("events") or [])
+    lines.append("")
+    lines.append(f"event window (offsets on the trigger's clock, "
+                 f"showing last {min(top, len(events))}):")
+    for e in events[-top:]:
+        off = float(e.get("ts", trigger)) - trigger
+        kind = str(e.get("kind", "?"))
+        who = ""
+        if e.get("rank") is not None:
+            who = f" rank={e['rank']}"
+        elif e.get("worker") is not None:
+            who = f" worker={e['worker']}"
+        detail = ""
+        if kind == "span":
+            detail = (f" {e.get('name')}"
+                      f" +{_fmt_ms(float(e.get('dur_s') or 0.0))}")
+        elif kind.startswith("alert."):
+            detail = (f" {e.get('alert')} value={e.get('value')}"
+                      f" episode={e.get('episode')}")
+        else:
+            extras = {k: v for k, v in e.items()
+                      if k not in ("ts", "kind", "rank", "worker",
+                                   "run_id", "generation", "world_size")
+                      and not isinstance(v, (dict, list))}
+            if e.get("generation") is not None:
+                detail = f" gen={e['generation']}"
+            detail += "".join(f" {k}={v}" for k, v in
+                              sorted(extras.items())[:4])
+        lines.append(f"  {off:>+9.3f}s  {kind:<24}{who}{detail}")
+    deltas = doc.get("metric_deltas") or {}
+    if deltas:
+        lines.append("")
+        lines.append("metric deltas over the last good window:")
+        for name, delta in list(deltas.items())[:12]:
+            lines.append(f"  {name:<56} +{delta:g}")
+    traces = doc.get("rpc_traces") or []
+    if traces:
+        lines.append("")
+        lines.append(f"stitched request traces ({len(traces)}):")
+        for t in traces[:5]:
+            crit = t.get("critical") or {}
+            root = t.get("root") or {}
+            shard = (f", shard {crit['shard']}"
+                     if crit.get("shard") is not None else "")
+            lines.append(
+                f"  {str(t.get('trace_id'))[:16]}  {root.get('name')}"
+                f"  {_fmt_ms(float(t.get('wall_s') or 0.0))}"
+                f"  bound by {crit.get('name')}{shard}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Follow mode (live JSONL tail)
+# ---------------------------------------------------------------------------
+
+
+class FollowReader:
+    """Incremental JSONL reader for ``--follow``: each :meth:`poll`
+    returns the records appended since the last one. Survives a file
+    that does not exist yet, keeps a torn (still-being-written) final
+    line buffered until its newline lands, and resets cleanly when the
+    file is truncated/rotated under it."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._tail = b""
+
+    def poll(self) -> List[Dict[str, Any]]:
+        import os
+
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self._pos:  # truncated/rotated: start over
+            self._pos = 0
+            self._tail = b""
+        if size == self._pos:
+            return []
+        # Binary read: getsize/seek offsets are BYTES, and a writer's
+        # flush boundary can land mid-UTF-8-character — torn bytes stay
+        # buffered with the torn line until the rest lands, instead of
+        # a UnicodeDecodeError killing the live tail.
+        with open(self.path, "rb") as f:
+            f.seek(self._pos)
+            chunk = f.read()
+            self._pos = f.tell()
+        data = self._tail + chunk
+        lines = data.split(b"\n")
+        self._tail = lines.pop()  # torn final line: wait for newline
+        out: List[Dict[str, Any]] = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+
+# Record kinds --follow renders (everything else is metric volume the
+# tail mode exists to cut through). "span" is deliberately absent.
+_FOLLOW_PREFIXES = ("alert.", "ctl.", "ft_", "chaos", "gang_snapshot")
+
+
+def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
+    """One tail line for a sink record — alerts and control-plane
+    transitions as they land, collector snapshots condensed to a
+    liveness one-liner. None = not a record the tail shows."""
+    kind = str(rec.get("kind") or "")
+    if not kind.startswith(_FOLLOW_PREFIXES):
+        return None
+    ts = float(rec.get("ts") or 0.0)
+    stamp = f"{ts:.3f}"
+    if kind == "gang_snapshot":
+        ranks = rec.get("ranks") or {}
+        ok = sum(1 for s in ranks.values() if s.get("ok"))
+        hb = rec.get("heartbeats") or {}
+        skew = hb.get("step_skew")
+        return (f"{stamp}  gang_snapshot       ranks {ok}/{len(ranks)} ok"
+                + (f", step skew {skew}" if skew is not None else ""))
+    if kind.startswith("alert."):
+        return (f"{stamp}  {kind:<18}  {rec.get('alert')}"
+                f"  value={rec.get('value')}"
+                f"  threshold={rec.get('threshold')}"
+                f"  episode={rec.get('episode')}")
+    who = ""
+    if rec.get("rank") is not None:
+        who = f" rank={rec['rank']}"
+    elif rec.get("worker") is not None:
+        who = f" worker={rec['worker']}"
+    gen = (f" gen={rec['generation']}"
+           if rec.get("generation") is not None else "")
+    extras = {k: v for k, v in rec.items()
+              if k not in ("ts", "kind", "rank", "worker", "run_id",
+                           "generation", "world_size")
+              and not isinstance(v, (dict, list))}
+    detail = "".join(f" {k}={v}" for k, v in sorted(extras.items())[:4])
+    return f"{stamp}  {kind:<18} {who}{gen}{detail}"
+
+
+def follow(path: str, poll_s: float = 0.2, stop=None,
+           max_records: Optional[int] = None):
+    """Generator of renderable tail lines from a growing JSONL sink —
+    the engine under ``timeline --follow`` (the CLI prints; tests
+    consume with ``max_records``/``stop``). Existing records render
+    first, then new ones as they land."""
+    import time as _time
+
+    reader = FollowReader(path)
+    emitted = 0
+    while True:
+        for rec in reader.poll():
+            line = render_follow_line(rec)
+            if line is None:
+                continue
+            yield line
+            emitted += 1
+            if max_records is not None and emitted >= max_records:
+                return
+        if stop is not None and stop.is_set():
+            return
+        _time.sleep(poll_s)
+
+
+# ---------------------------------------------------------------------------
 # Auto-tune rendering (the search's ranking + prune decisions)
 # ---------------------------------------------------------------------------
 
@@ -482,23 +685,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "or a collector sink (stitched "
                              "rpc_traces): one tree per sampled "
                              "request, critical path starred")
+    parser.add_argument("--postmortem", action="store_true",
+                        help="render a flight-recorder postmortem "
+                             "bundle (postmortem_<ts>.json): causal "
+                             "event window, metric deltas, world doc, "
+                             "stitched traces")
+    parser.add_argument("--follow", action="store_true",
+                        help="tail a growing JSONL sink live: render "
+                             "alert firings and control-plane "
+                             "transitions as they land (Ctrl-C stops)")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis dict as JSON")
-    parser.add_argument("--top", type=int, default=10,
-                        help="top-K slowest ops to list")
+    parser.add_argument("--top", type=int, default=None,
+                        help="top-K entries to list (default 10; "
+                             "postmortem event window defaults to 40)")
     parser.add_argument("--step-name", default="train_step",
                         help="step annotation event name")
     args = parser.parse_args(argv)
     args.path = args.paths[0]
+    # Per-mode defaults: an EXPLICIT --top always wins (a postmortem's
+    # wider 40-event window is a default, not a floor).
+    if args.top is None:
+        args.top = 40 if args.postmortem else 10
 
-    if sum((args.gang, args.tune, args.rpc)) > 1:
-        print("error: --gang, --tune and --rpc are different reports; "
-              "pick one")
+    if sum((args.gang, args.tune, args.rpc, args.postmortem,
+            args.follow)) > 1:
+        print("error: --gang, --tune, --rpc, --postmortem and --follow "
+              "are different reports; pick one")
         return 2
     if args.tune:
         return _main_tune(args)
     if args.rpc:
         return _main_rpc(args)
+    if args.postmortem:
+        return _main_postmortem(args)
+    if args.follow:
+        return _main_follow(args)
     if args.gang:
         return _main_gang(args)
     if len(args.paths) > 1:
@@ -596,6 +818,40 @@ def _main_rpc(args) -> int:
           else render_rpc_report(traces, top=args.top), end="")
     if args.json:
         print()
+    return 0
+
+
+def _main_postmortem(args) -> int:
+    """--postmortem: render one flight-recorder bundle."""
+    if len(args.paths) > 1:
+        print("error: --postmortem renders one bundle at a time")
+        return 2
+    from sparktorch_tpu.obs.blackbox import read_postmortem
+
+    try:
+        doc = read_postmortem(args.paths[0])
+    except (OSError, ValueError) as e:
+        print(f"error: {e}")
+        return 1
+    print(json.dumps(doc) if args.json
+          else render_postmortem_report(doc, top=args.top),
+          end="" if not args.json else "\n")
+    return 0
+
+
+def _main_follow(args) -> int:
+    """--follow: live-tail a JSONL sink until interrupted."""
+    if len(args.paths) > 1:
+        print("error: --follow tails one JSONL file at a time")
+        return 2
+    if not _looks_like_jsonl(args.paths[0]):
+        print("error: --follow tails a telemetry/collector .jsonl")
+        return 2
+    try:
+        for line in follow(args.paths[0]):
+            print(line, flush=True)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

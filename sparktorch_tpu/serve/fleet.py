@@ -59,7 +59,7 @@ import numpy as np
 
 from sparktorch_tpu.net import wire as binwire
 from sparktorch_tpu.net.sharded import _RING_REPLICAS, HashRing
-from sparktorch_tpu.obs import Telemetry
+from sparktorch_tpu.obs import Telemetry, wall_ts
 from sparktorch_tpu.serve.param_server import (
     MAX_TOLERATED_ERRORS,
     ParamServerHttp,
@@ -273,7 +273,7 @@ class ParamShardServer:
                     f"param shard {self.shard_id} is stopped"
                 )
             self._queue.put((flat, done, trace_ctx,
-                             time.time(), time.perf_counter()))
+                             wall_ts(), time.perf_counter()))
         self.telemetry.counter("param_server.pushes", labels=self._labels)
         if wait and not done.wait(timeout):
             raise TimeoutError(

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sparktorch_tpu.ft import chaos as _chaos
+from sparktorch_tpu.obs.telemetry import wall_ts
 from sparktorch_tpu.net import wire as _wire
 from sparktorch_tpu.net.transport import TransportError
 from sparktorch_tpu.utils.locks import VersionedSlot
@@ -110,7 +111,7 @@ class _Request:
         self.x = x
         self.n = int(x.shape[0])
         self.future = InferFuture()
-        self.enq_ts = time.time()
+        self.enq_ts = wall_ts()
         self.enq_t0 = time.perf_counter()
         self.deadline_t = self.enq_t0 + float(deadline_s)
         self.trace_ctx = trace_ctx
@@ -288,7 +289,7 @@ class InferenceReplica:
                                labels=self._labels)
         self.telemetry.gauge("serve.params_version", self.params_version,
                              labels=self._labels)
-        self.telemetry.gauge("serve.weight_last_update_ts", time.time(),
+        self.telemetry.gauge("serve.weight_last_update_ts", wall_ts(),
                              labels=self._labels)
 
     @property
@@ -438,7 +439,7 @@ class InferenceReplica:
             # ONE slot read per batch: params and model_state flip
             # together (the live-update atomicity contract).
             _sv, (params, state) = self._slot.read()
-            exec_ts = time.time()
+            exec_ts = wall_ts()
             exec_t0 = time.perf_counter()
             try:
                 # Pad/concat inside the guarded region: ANY failure

@@ -49,6 +49,7 @@ from sparktorch_tpu.obs import (
     PROMETHEUS_CONTENT_TYPE,
     Telemetry,
     render_prometheus,
+    wall_ts,
 )
 from sparktorch_tpu.obs import rpctrace as _rpctrace
 from sparktorch_tpu.utils.early_stopper import EarlyStopping
@@ -175,7 +176,7 @@ class ParameterServer:
             raise RuntimeError("parameter server failed") from self._failed
         done = threading.Event() if wait else None
         self._queue.put((grads, done, trace_ctx,
-                         time.time(), time.perf_counter()))
+                         wall_ts(), time.perf_counter()))
         self.telemetry.counter("param_server.pushes")
         self.telemetry.gauge("param_server.queue_depth", self._queue.qsize())
         if done is not None and not done.wait(timeout):

@@ -97,13 +97,16 @@ class Router:
         self._rng = random.Random(self.policy.seed)
         self._lock = threading.Lock()
         self._replicas: Dict[str, _ReplicaState] = {}
-        # Routing-weight cache: the p50 read costs a percentile over
-        # the histogram ring UNDER THE BUS LOCK (or a collector
-        # snapshot merge) — per-request freshness there would
-        # serialize the router against the very replicas it routes to
-        # (measured 3x throughput loss under a 400-thread open-loop
-        # flood). Load shifts on the outstanding term instantly; the
-        # latency WEIGHT only needs to follow on this horizon.
+        # Routing-weight cache: a fresh p50 read costs a percentile
+        # over the histogram ring (or a collector snapshot merge).
+        # The bus now snapshots the ring under its lock and computes
+        # the percentile OUTSIDE it (obs.telemetry.rollup_from_state —
+        # the PR 9 regression where per-request reads serialized the
+        # router against its own replicas, 3x throughput at 400
+        # threads, is pinned by test_obs_history's contention test),
+        # but the math itself is still worth amortizing: load shifts
+        # on the outstanding term instantly; the latency WEIGHT only
+        # needs to follow on this horizon.
         self._p50_ttl_s = 0.25
         self._p50_cache: Dict[str, Tuple[float, Optional[float]]] = {}
         self._stop = threading.Event()
