@@ -7,7 +7,7 @@ PYTHON ?= python
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
 	bench-ps-fleet bench-tune bench-rpc-trace bench-serve \
-	bench-elastic bench-obs-history cluster-up clean lint-obs
+	bench-elastic bench-obs-history bench-moe cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -167,6 +167,25 @@ bench-trace:
 	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
 	$(PYTHON) -m sparktorch_tpu.bench --config sharded_trace
 	$(PYTHON) -m sparktorch_tpu.bench --config gang_obs
+	$(MAKE) bench-moe
+
+# MoE expert-parallel dispatch gate: on the same ep=2 mesh and matched
+# init, the explicit shard_map all-to-all dispatch must move STRICTLY
+# fewer per-device HLO collective bytes than the legacy token-
+# replication lowering (with all-to-alls present and zero all-gathers
+# in its program), at equal-or-better median step wall
+# (SPARKTORCH_TPU_MOE_WALL_TOL, default 0.05) and identical losses
+# (rtol 1e-5) — FAILS otherwise. The tuner's ep a2a byte term is
+# cross-checked against the measured HLO bytes (factor band), and the
+# record is retained so the byte-reduction drift gate arms against the
+# windowed median of prior rounds (SPARKTORCH_TPU_MOE_DRIFT_TOL,
+# relative, default 0.25). Also chained into bench-trace. Defaults to
+# the 8-virtual-device CPU backend so it runs anywhere.
+bench-moe:
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
+	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+	$(PYTHON) -m sparktorch_tpu.bench --config moe_a2a \
+		--log benchmarks/bench_r10_moe.jsonl
 
 # Mesh auto-tuner gate: the trace-guided tuner (enumerate -> analytic
 # comm-volume prune -> profiled measurement with early stop) must pick

@@ -3792,6 +3792,228 @@ def bench_moe_lm() -> dict:
     }
 
 
+def bench_moe_a2a() -> dict:
+    """MoE expert-parallel dispatch gate (``make bench-moe``): on the
+    same ep=2 mesh and matched init, the explicit shard_map all-to-all
+    dispatch (``moe_ep_dispatch='a2a'``) must beat the legacy
+    partitioner-derived token-replication path (``'replicate'`` — jax
+    0.4.x GSPMD lowers it to all-gather + all-reduce) on
+
+    - **collective bytes, strictly**: per-device HLO collective result
+      bytes (:func:`sparktorch_tpu.obs.xprof.hlo_collective_bytes` —
+      static, partitioner-independent, no profiler noise), with the
+      a2a leg containing all-to-alls and ZERO all-gathers;
+    - **step wall, equal-or-better**: medians over interleaved
+      measurement rounds (the rig-noise discipline every gate here
+      uses), within ``SPARKTORCH_TPU_MOE_WALL_TOL`` (default 0.05 —
+      the byte win must not come at a wall cost);
+    - **identical numbers**: both legs' losses agree at rtol 1e-5
+      (the dispatch rewrite is a layout choice, pinned here end to
+      end, not just in the unit suite).
+
+    The tuner's ep a2a byte term (``predict_comm_bytes``:
+    ``ep_all_to_all``) is validated against the measured HLO bytes —
+    recorded as ``predicted_vs_hlo_a2a`` and gated to a factor band
+    (the model is a monotone ranker, not a simulator; the band catches
+    sign/scale regressions like a dropped capacity term).
+
+    Retained (``--log benchmarks/bench_r10_moe.jsonl``) so the drift
+    gate arms: the byte-reduction ratio must not collapse vs the
+    windowed median of prior rounds (``SPARKTORCH_TPU_MOE_DRIFT_TOL``,
+    relative, default 0.25)."""
+    import dataclasses as _dc
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparktorch_tpu.models import CausalLM
+    from sparktorch_tpu.models.transformer import TransformerConfig
+    from sparktorch_tpu.obs.xprof import hlo_collective_bytes
+    from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
+    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+    from sparktorch_tpu.parallel.tune import (
+        mesh_label,
+        predict_comm_bytes,
+        transformer_workload,
+    )
+    from sparktorch_tpu.train.sharded import (
+        create_sharded_state,
+        make_sharded_train_step,
+        shard_batch,
+    )
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    n_dev = len(jax.devices())
+    if n_dev % 2:
+        raise AssertionError(
+            f"bench moe_a2a needs an even device count for ep=2; got "
+            f"{n_dev} (set XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=8 on a CPU rig)"
+        )
+    # Sized so the dispatch/combine traffic is a real fraction of the
+    # step (d_model*seq*cf*k capacity blocks per MoE layer) without
+    # blowing the CPU rig's step wall.
+    base_cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+        max_len=64, n_experts=8, moe_every=1, moe_top_k=2,
+        moe_group_size=64,
+    )
+    mesh = build_mesh(MeshConfig(ep=2))
+    mesh_ran = mesh_label(dict(mesh.shape))
+    rng = np.random.default_rng(0)
+    bsz = 4 * n_dev
+    ids = rng.integers(0, base_cfg.vocab_size, (bsz, 65)).astype(np.int32)
+    batch = DataBatch(x=jnp.asarray(ids[:, :-1]), y=jnp.asarray(ids[:, 1:]),
+                      w=jnp.ones((bsz,), jnp.float32))
+
+    # The persistent compile cache is disarmed for collective-bearing
+    # programs on CPU (tests/conftest.py / ROADMAP).
+    old_cache = jax.config.jax_compilation_cache_dir
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        # Dense CE, not the registry's fused Pallas kernel: on this
+        # CPU rig the kernel runs in interpret mode — a while loop the
+        # partitioner can only all-gather the (tokens, vocab) logits
+        # into — which would put LOSS-path all-gathers in both legs'
+        # HLO and blind the "zero all-gathers in the a2a program" gate
+        # to the dispatch bytes this bench exists to measure.
+        from sparktorch_tpu.utils.losses import cross_entropy_loss
+
+        legs = {}
+        for dispatch in ("replicate", "a2a"):
+            cfg = _dc.replace(base_cfg, moe_ep_dispatch=dispatch)
+            spec = ModelSpec(module=CausalLM(cfg), loss=cross_entropy_loss,
+                             optimizer="adamw",
+                             optimizer_params={"lr": 1e-3})
+            tx = spec.make_optimizer()
+            state, shardings = create_sharded_state(
+                spec, mesh, jax.random.key(0),
+                sample_x=np.asarray(batch.x[:1]), tx=tx,
+            )
+            step = make_sharded_train_step(
+                spec.make_module().apply, spec.loss_fn(), tx, mesh,
+                shardings,
+            )
+            sharded = shard_batch(batch, mesh)
+            with _set_mesh(mesh):
+                compiled = step.jitted.lower(state, sharded).compile()
+            hlo_stats = hlo_collective_bytes(compiled.as_text())
+            # Compile+warm outside timing.
+            state, m = step(state, sharded)
+            jax.block_until_ready(m.loss)
+            legs[dispatch] = {
+                "step": step, "state": state, "batch": sharded,
+                "hlo": hlo_stats, "losses": [float(m.loss)], "walls": [],
+            }
+
+        # Interleaved rounds: back-to-back per-leg timing on a shared
+        # rig swings whole windows into slow scheduler epochs — the
+        # same discipline as bench-tune/bench-ps-fleet.
+        steps_per_round, rounds = 3, 4
+        for _ in range(rounds):
+            for leg in legs.values():
+                t0 = time.perf_counter()
+                st = leg["state"]
+                for _ in range(steps_per_round):
+                    st, m = leg["step"](st, leg["batch"])
+                jax.block_until_ready(m.loss)
+                leg["state"] = st
+                leg["walls"].append(
+                    (time.perf_counter() - t0) / steps_per_round
+                )
+                leg["losses"].append(float(m.loss))
+
+        rep, a2a = legs["replicate"], legs["a2a"]
+
+        # ---- gate 1: strictly fewer collective bytes ---------------------
+        bytes_rep = rep["hlo"]["total_bytes"]
+        bytes_a2a = a2a["hlo"]["total_bytes"]
+        if not (0 < bytes_a2a < bytes_rep):
+            raise AssertionError(
+                f"a2a path must move strictly fewer collective bytes: "
+                f"a2a={bytes_a2a} vs replicate={bytes_rep} "
+                f"(families: a2a={a2a['hlo']}, rep={rep['hlo']})"
+            )
+        if a2a["hlo"]["counts"].get("all_to_all", 0) < 4 \
+                or a2a["hlo"]["counts"].get("all_gather", 0) != 0:
+            raise AssertionError(
+                f"a2a leg HLO shape wrong (want >=4 all-to-alls — "
+                f"dispatch+combine, fwd+bwd, per MoE layer — and zero "
+                f"all-gathers): {a2a['hlo']}"
+            )
+
+        # ---- gate 2: equal-or-better step wall ---------------------------
+        wall_rep = float(np.median(rep["walls"]))
+        wall_a2a = float(np.median(a2a["walls"]))
+        wall_tol = float(os.environ.get("SPARKTORCH_TPU_MOE_WALL_TOL",
+                                        "0.05"))
+        if wall_a2a > wall_rep * (1.0 + wall_tol):
+            raise AssertionError(
+                f"a2a step wall regressed vs the token-replication "
+                f"path: {wall_a2a * 1e3:.2f}ms vs {wall_rep * 1e3:.2f}ms "
+                f"(tol {wall_tol:.0%}; walls a2a={a2a['walls']}, "
+                f"rep={rep['walls']})"
+            )
+
+        # ---- gate 3: layout must not change the math ---------------------
+        np.testing.assert_allclose(a2a["losses"], rep["losses"], rtol=1e-5)
+
+        # ---- gate 4: tuner ep byte model vs HLO ground truth -------------
+        shape = transformer_workload(base_cfg, global_batch=bsz)
+        predicted = predict_comm_bytes(MeshConfig(ep=2), shape, n_dev)
+        # predict_comm_bytes models the FORWARD dispatch+combine pair
+        # fleet-wide; the compiled HLO is per-device and includes the
+        # backward pair -> model ~= hlo_bytes * n_dev / 2.
+        hlo_a2a_fleet_fwd = a2a["hlo"]["bytes"]["all_to_all"] * n_dev / 2
+        ratio = predicted["ep_all_to_all"] / max(hlo_a2a_fleet_fwd, 1.0)
+        if not (0.25 <= ratio <= 4.0):
+            raise AssertionError(
+                f"tuner ep_all_to_all byte model off the HLO ground "
+                f"truth by {ratio:.2f}x (predicted "
+                f"{predicted['ep_all_to_all']:.0f}, HLO fwd-pair "
+                f"fleet-wide {hlo_a2a_fleet_fwd:.0f}) — the a2a term "
+                "no longer tracks the real lowering"
+            )
+
+        # ---- gate 5: drift vs retained prior rounds ----------------------
+        byte_ratio = bytes_rep / bytes_a2a
+        drift_tol = float(os.environ.get("SPARKTORCH_TPU_MOE_DRIFT_TOL",
+                                         "0.25"))
+        prior = _prior_window("moe_a2a", "collective_byte_ratio",
+                              mesh=mesh_ran)
+        if prior is None:
+            drift = {"status": "no_prior_record", "tolerance": drift_tol}
+        else:
+            drift = {"status": "checked", "tolerance": drift_tol,
+                     "prior": prior}
+            if byte_ratio < prior["median"] * (1.0 - drift_tol):
+                raise AssertionError(
+                    f"moe_a2a: collective byte reduction collapsed "
+                    f"{prior['median']:.2f}x -> {byte_ratio:.2f}x "
+                    f"(beyond the {drift_tol:.0%} tolerance); {drift}"
+                )
+
+        return {
+            "config": "moe_a2a", "unit": "x fewer collective bytes",
+            "value": round(byte_ratio, 3),
+            "collective_byte_ratio": round(byte_ratio, 3),
+            "mesh": mesh_ran, "n_chips": n_dev,
+            "a2a_step_wall_s": round(wall_a2a, 6),
+            "replicate_step_wall_s": round(wall_rep, 6),
+            "wall_ratio": round(wall_a2a / wall_rep, 3),
+            "a2a_hlo": a2a["hlo"], "replicate_hlo": rep["hlo"],
+            "loss_parity_rtol": 1e-5,
+            "predicted_vs_hlo_a2a": round(ratio, 3),
+            "drift": drift,
+        }
+    finally:
+        if jax.default_backend() == "cpu":
+            jax.config.update("jax_compilation_cache_dir", old_cache)
+
+
 CONFIGS: Dict[str, Callable[[], dict]] = {
     "mnist_mlp_sync": bench_mnist_mlp_sync,
     "mnist_cnn_sync": bench_mnist_cnn_sync,
@@ -3808,6 +4030,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "sharded_trace": bench_sharded_trace,
     "gang_obs": bench_gang_obs,
     "mesh_tune": bench_mesh_tune,
+    "moe_a2a": bench_moe_a2a,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,
     "long_context_lm": bench_long_context_lm,

@@ -11,8 +11,11 @@ first-class here:
   (:mod:`sparktorch_tpu.ops.attention`) — the sequence axis is
   sharded over the mesh's ``sp`` axis and K/V blocks rotate over ICI,
   so max sequence length scales linearly with the number of chips.
-  Requires running under ``jax.set_mesh(mesh)`` (the sharded trainer
-  does this), because the shard_map island resolves the ambient mesh.
+  In the pipeline trainer the rotation rides the schedule's own
+  shard_map; under the GSPMD trainer the partitioner computes the
+  global dense attention over the sp sharding (the island form is
+  opt-in via ``SPARKTORCH_TPU_GSPMD_RING_ISLAND=1`` — it shifts
+  blockwise-softmax rounding at bf16, see ``MultiHeadAttention``).
 
 Tensor parallelism: head and FFN dims are sharded over ``tp`` by the
 sharding rules in :mod:`sparktorch_tpu.parallel.sharding_rules`; XLA
@@ -22,14 +25,16 @@ GSPMD inserts the tp collectives. Heads must divide the tp size.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from functools import partial
+from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sparktorch_tpu.ops.attention import dense_attention, ring_attention
+from sparktorch_tpu.parallel.compat import ambient_gspmd_mesh
 from sparktorch_tpu.parallel.mesh import AXIS_EP, BATCH_AXES
 
 
@@ -49,8 +54,8 @@ class TransformerConfig:
     remat: bool = False
     # Mixture-of-experts (0 = dense FFN everywhere). Expert weights
     # carry a leading experts dim that the sharding rules lay out over
-    # the ``ep`` mesh axis; GSPMD then derives the dispatch/combine
-    # all-to-alls from the einsum operand shardings.
+    # the ``ep`` mesh axis; the dispatch/combine are explicit shard_map
+    # all-to-alls (MoEFFN / _ep_relayout), never partitioner-derived.
     n_experts: int = 0
     moe_every: int = 2          # every k-th layer uses the MoE FFN
     capacity_factor: float = 1.25
@@ -63,20 +68,30 @@ class TransformerConfig:
     # the dispatch/combine one-hots are O(n * group * cf) elements —
     # linear in total tokens — instead of O(n^2) with global routing.
     moe_group_size: int = 4096
-    # How tokens reach their experts across the ``ep`` mesh axis in the
-    # pipeline trainer's manual MoE path (train/pipeline.py):
+    # How tokens reach their experts across the ``ep`` mesh axis —
+    # governs BOTH manual-ep paths (the pipeline trainer's shard_map
+    # MoE in train/pipeline.py, and the GSPMD trainer's MoEFFN, whose
+    # dispatch/combine are explicit shard_map all_to_all islands):
     # 'a2a'       — GShard-style: each ep member routes only its own
     #               slice of the routing groups and token blocks travel
     #               to their experts' owners over an all_to_all (and
     #               back) — per-member routing/dispatch work and
-    #               activation bytes scale 1/ep;
-    # 'replicate' — every member routes the full batch and computes its
-    #               expert slice, one psum combines (the round-4
-    #               layout; correct but does not shrink with ep);
-    # 'auto'      — 'a2a' when the group count divides by ep, else
-    #               'replicate'. The GSPMD trainer is unaffected: there
-    #               the layout comes from sharding constraints and XLA
-    #               derives the all-to-alls.
+    #               activation bytes scale 1/ep. Raises at trace time
+    #               if the group count cannot shard evenly.
+    # 'replicate' — no explicit dispatch collectives. In the pipeline
+    #               trainer: every ep member routes the full batch and
+    #               computes its expert slice, one psum combines (the
+    #               round-4 layout; correct but does not shrink with
+    #               ep). In the GSPMD trainer: the layout is left to
+    #               sharding constraints and the partitioner — which on
+    #               jax 0.4.x lowers to all-gather + all-reduce (full
+    #               token replication); kept ONLY as the bench-moe
+    #               control leg and an escape hatch.
+    # 'auto'      — 'a2a' when the routing groups shard evenly, else
+    #               'replicate'. Under the GSPMD trainer the group
+    #               partition is mesh-anchored (see moe_group_partition)
+    #               so 'auto' reaches the a2a path whenever the token
+    #               count divides the device count.
     moe_ep_dispatch: str = "auto"
     # CausalLM: share the input embedding matrix with the LM head
     # (logits = h @ E^T) — halves the vocab-sized params.
@@ -118,7 +133,8 @@ class MultiHeadAttention(nn.Module):
             from sparktorch_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, cfg.causal)
-        elif cfg.attn_impl == "ring" and _sp_mesh_available():
+        elif cfg.attn_impl == "ring" and _ring_island_enabled() \
+                and _sp_mesh_available(q.shape):
             from sparktorch_tpu.train.step import shard_map_compat
 
             spec = P(BATCH_AXES, "sp", "tp", None)
@@ -126,73 +142,273 @@ class MultiHeadAttention(nn.Module):
                 lambda q, k, v: ring_attention(
                     q, k, v, axis_name="sp", causal=cfg.causal
                 ),
-                mesh=None,  # ambient mesh (jax.set_mesh)
+                mesh=ambient_gspmd_mesh(),
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
             )
             out = attn(q, k, v)
         else:
-            # dense — also the ring fallback when no GSPMD mesh with
-            # sp>1 is ambient (plain init/apply, inference transforms,
-            # manual-axis trainers): ring IS dense attention computed
-            # blockwise, so a ring-trained model applies anywhere.
+            # dense — the ring default under the GSPMD trainer and the
+            # fallback everywhere else (plain init/apply, inference
+            # transforms, manual-axis trainers): ring IS dense
+            # attention computed blockwise, so a ring-trained model
+            # applies anywhere. Under a GSPMD mesh with sp>1 the
+            # partitioner computes THIS global dense attention over the
+            # sequence sharding itself — the correctness the sp/ep
+            # parity matrix pins; the explicit ring island
+            # (SPARKTORCH_TPU_GSPMD_RING_ISLAND=1) changes blockwise-
+            # softmax rounding at bf16 and is opt-in on this jax line.
+            # (The pipeline trainer's ring — where the rotation is
+            # load-bearing — is unaffected: it rides the pp shard_map,
+            # not this island.)
             out = dense_attention(q, k, v, causal=cfg.causal)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=dt, name="proj"
         )(out)
 
 
-def _sp_mesh_available() -> bool:
+def _ring_island_enabled() -> bool:
+    """Opt-in knob for the GSPMD ring-attention island. Off by
+    default: GSPMD computes the global dense attention over the sp
+    sharding itself, and the island's blockwise softmax would shift
+    bf16 rounding vs the dense-reference parity matrix."""
+    import os
+
+    return os.environ.get(
+        "SPARKTORCH_TPU_GSPMD_RING_ISLAND", "0"
+    ) not in ("", "0", "false", "off")
+
+
+def _sp_mesh_available(qkv_shape=None) -> bool:
     """Whether a GSPMD (non-Manual) ambient mesh with sp > 1 is in
     scope — the only context where the ring-attention shard_map island
     can (and should) open. Everywhere else — plain init/apply with no
     mesh, or inside a shard_map trainer where axes are Manual — ring
-    falls back to dense (same math, single block)."""
-    try:
-        am = jax.sharding.get_abstract_mesh()
-        if am is None or "sp" not in am.shape or am.shape["sp"] <= 1:
-            return False
-        types = dict(zip(am.axis_names, am.axis_types))
-        return "Manual" not in str(types["sp"])
-    except Exception:
+    falls back to dense (same math, single block). With ``qkv_shape``
+    given, the island's (b, s, h, hd) in_spec must also divide
+    (batch over dp+fsdp, sequence over sp, heads over tp)."""
+    mesh = ambient_gspmd_mesh()
+    if mesh is None or dict(mesh.shape).get("sp", 1) <= 1:
         return False
+    if qkv_shape is not None:
+        sizes = dict(mesh.shape)
+        b, s, h = qkv_shape[0], qkv_shape[1], qkv_shape[2]
+        n_batch = 1
+        for ax in BATCH_AXES:
+            n_batch *= sizes.get(ax, 1)
+        if b % n_batch or s % sizes["sp"] or h % sizes.get("tp", 1):
+            return False
+    return True
 
 
 def _gspmd_constraint(x, spec: P):
-    """``with_sharding_constraint`` iff the ambient (set_mesh) mesh has
-    every axis the spec names in GSPMD (non-Manual) mode — i.e. the
-    GSPMD sharded trainer. Inside a shard_map trainer (DP or pipeline)
-    those axes are Manual and the constraint would be meaningless-to-
-    wrong, and under plain apply (inference, tests) there is no mesh at
-    all; both cases fall through to identity."""
-    try:
-        am = jax.sharding.get_abstract_mesh()
-        if am is None or not am.shape:
-            return x
-        types = dict(zip(am.axis_names, am.axis_types))
-        axes = [
-            a
-            for part in spec
-            if part is not None
-            for a in (part if isinstance(part, tuple) else (part,))
-        ]
-        for ax in axes:
-            if ax not in types or "Manual" in str(types[ax]):
-                return x
-        # Each constrained dim must divide its axes' total extent —
-        # constraining a 1-group tensor across 8 devices just forces
-        # an involuntary full reshard (SPMD partitioner warning).
-        for dim, part in zip(x.shape, spec):
-            if part is None:
-                continue
-            total = 1
-            for a in (part if isinstance(part, tuple) else (part,)):
-                total *= am.shape[a]
-            if total > 1 and dim % total != 0:
-                return x
-        return jax.lax.with_sharding_constraint(x, spec)
-    except Exception:  # no mesh context / legacy jax — layout hint only
+    """``with_sharding_constraint`` iff an ambient (set_mesh) mesh is
+    in scope in GSPMD (non-Manual) mode — i.e. the GSPMD sharded
+    trainer. Inside a shard_map trainer (DP or pipeline) the axes are
+    Manual and the constraint would be meaningless-to-wrong, and under
+    plain apply (inference, tests) there is no mesh at all; both cases
+    fall through to identity (:func:`ambient_gspmd_mesh` returns
+    None)."""
+    mesh = ambient_gspmd_mesh()
+    if mesh is None:
         return x
+    sizes = dict(mesh.shape)
+    for part in spec:
+        if part is None:
+            continue
+        for a in (part if isinstance(part, tuple) else (part,)):
+            if a not in sizes:
+                return x
+    # Each constrained dim must divide its axes' total extent —
+    # constraining a 1-group tensor across 8 devices just forces
+    # an involuntary full reshard (SPMD partitioner warning).
+    for dim, part in zip(x.shape, spec):
+        if part is None:
+            continue
+        total = 1
+        for a in (part if isinstance(part, tuple) else (part,)):
+            total *= sizes[a]
+        if total > 1 and dim % total != 0:
+            return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def moe_group_partition(cfg, n: int,
+                        n_shards: Optional[int] = None) -> Tuple[int, int]:
+    """``(group size, group count)`` for routing ``n`` tokens — THE one
+    definition of the MoE group partition, shared by the flax
+    :class:`MoEFFN` and the pipeline trainer's manual MoE paths.
+
+    Base rule: the largest ``g <= cfg.moe_group_size`` dividing ``n``
+    (trace-time ints, the loop is free). With ``n_shards`` (the GSPMD
+    trainer passes its mesh's TOTAL device count), ``g`` must also
+    keep ``n/g`` divisible by ``n_shards`` — at least one routing
+    group per device, the GShard layout — so the groups dim shards
+    evenly over dp x fsdp x ep and the dispatch all-to-all can engage.
+    Anchoring on the whole device count (not dp*fsdp*ep) keeps the
+    partition IDENTICAL across every mesh shape of the same rig, which
+    is what makes ep (and tp/sp/fsdp) a pure layout choice in the
+    parity tests. Falls back to the base rule when ``n`` has no such
+    divisor (then the a2a path cannot engage either)."""
+    cap = max(1, cfg.moe_group_size)
+    if n_shards and n_shards > 1 and n % n_shards == 0:
+        per_shard = n // n_shards
+        g = min(per_shard, cap)
+        while per_shard % g:
+            g -= 1
+        return g, n // g
+    g = min(n, cap)
+    while n % g:
+        g -= 1
+    return g, n // g
+
+
+# ---------------------------------------------------------------------------
+# Explicit MoE dispatch/combine all-to-alls (the shard_map island)
+# ---------------------------------------------------------------------------
+
+
+def _moe_relayout_island(x, to_experts: bool):
+    """One tiled ``all_to_all`` over ``ep`` relaying a (G, e, cap, d)
+    capacity-block tensor between the two MoE layouts (specs in
+    :mod:`sparktorch_tpu.parallel.sharding_rules`):
+
+    - GROUPS layout (``to_experts=True`` input): groups dim sharded
+      over dp x fsdp x ep — each member holds its own groups' blocks
+      for EVERY expert;
+    - EXPERTS layout (output): experts dim sharded over ep — each
+      member holds every group's blocks for ITS experts.
+
+    Within an ep subgroup the exchange swaps expert slices for group
+    blocks, which is exactly the relayout of the UNCHANGED global
+    array: the island is a global identity, so it is numerics-proof by
+    construction — and partitioner-proof, because the all-to-all is
+    spelled out instead of derived (jax 0.4.x GSPMD derives all-gather
+    + all-reduce, replicating every token ep-fold). ``to_experts=False``
+    is the combine-side inverse."""
+    from sparktorch_tpu.parallel.sharding_rules import (
+        MOE_EXPERTS_BLOCKS_SPEC,
+        MOE_GROUPS_BLOCKS_SPEC,
+    )
+    from sparktorch_tpu.train.step import shard_map_compat
+
+    if to_experts:
+        body = lambda t: jax.lax.all_to_all(t, AXIS_EP, 1, 0, tiled=True)
+        in_s, out_s = MOE_GROUPS_BLOCKS_SPEC, MOE_EXPERTS_BLOCKS_SPEC
+    else:
+        body = lambda t: jax.lax.all_to_all(t, AXIS_EP, 0, 1, tiled=True)
+        in_s, out_s = MOE_EXPERTS_BLOCKS_SPEC, MOE_GROUPS_BLOCKS_SPEC
+    return shard_map_compat(
+        body, mesh=ambient_gspmd_mesh(), in_specs=(in_s,), out_specs=out_s,
+    )(x)
+
+
+def _top_k_routing(probs, k: int):
+    """``jax.lax.top_k`` equivalent for the router (first index wins
+    ties, like top_k), as ``k`` argmax+mask rounds. top_k's sort-based
+    partitioner lowering ALL-GATHERS the sharded probs tensor (the one
+    token-scale gather the HLO regression pin would flag); argmax
+    reduces only the (local) experts dim, so routing stays device-
+    local under the groups sharding."""
+    vals, idxs = [], []
+    p = probs
+    for _ in range(k):
+        i = jnp.argmax(p, axis=-1)
+        vals.append(jnp.max(p, axis=-1))
+        idxs.append(i)
+        # Finite mask sentinel: probs are softmax outputs in [0, 1],
+        # so -1 loses every later argmax. -inf would poison the next
+        # round's max/argmax gradients with (-inf * 0) NaNs in eager
+        # mode (jitted runs were rescued only by XLA's simplifier).
+        p = jnp.where(jax.nn.one_hot(i, p.shape[-1], dtype=bool),
+                      -1.0, p)
+    return jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _expert_ffn(x, w_in, b_in, w_out, b_out, dt):
+    """The dense per-expert FFN on (G, e, cap, d) capacity blocks —
+    custom VJP so the WEIGHT gradients are layout-invariant.
+
+    Autodiff would contract the weight grads over (groups x cap) in
+    one low-precision dot whose per-device extent depends on the mesh
+    (ep absorbs dp, so ep=2 holds 2x the groups per device that ep=1
+    does) — reassociating the bf16 reduction and drifting expert grads
+    ~1e-4 between worlds, which adamw amplifies well past the rtol
+    1e-5 ep-parity gate within a few steps. The custom backward
+    contracts each GROUP's partial separately (identical work on every
+    world — cap never shards) and accumulates across groups in f32, so
+    the only cross-world difference left is f32 psum ordering
+    (~1e-7/step). Forward math is exactly the inline version it
+    replaces."""
+    return _expert_ffn_fwd(x, w_in, b_in, w_out, b_out, dt)[0]
+
+
+def _expert_ffn_fwd(x, w_in, b_in, w_out, b_out, dt):
+    from sparktorch_tpu.parallel.sharding_rules import (
+        MOE_EXPERTS_BLOCKS_SPEC,
+    )
+
+    z = jnp.einsum("gecd,edf->gecf", x, w_in.astype(dt)) \
+        + b_in[None, :, None].astype(dt)
+    h = nn.gelu(z)
+    h = _gspmd_constraint(h, MOE_EXPERTS_BLOCKS_SPEC)
+    y = jnp.einsum("gecf,efd->gecd", h, w_out.astype(dt)) \
+        + b_out[None, :, None].astype(dt)
+    # Residuals hold z but NOT h: the post-gelu hidden is one
+    # elementwise gelu away, and saving both would double the
+    # dominant (G, e, cap, d_ff) activation footprint per MoE layer.
+    return y, (x, z, w_in, b_in, w_out, b_out)
+
+
+def _expert_ffn_bwd(dt, res, ct):
+    x, z, w_in, b_in, w_out, b_out = res
+    f32 = jnp.float32
+    h = nn.gelu(z)  # recomputed from the saved pre-activation
+    # Per-group partials contract over cap ONLY (world-consistent);
+    # the f32 sum over the groups dim is the one cross-device
+    # reduction (GSPMD psums it over the axes the groups shard over).
+    d_w_out = jnp.sum(
+        jnp.einsum("gecf,gecd->gefd", h, ct, preferred_element_type=f32),
+        axis=0,
+    )
+    d_b_out = jnp.sum(jnp.sum(ct.astype(f32), axis=2), axis=0)
+    d_h = jnp.einsum("gecd,efd->gecf", ct, w_out.astype(dt))
+    _, gelu_vjp = jax.vjp(nn.gelu, z)
+    d_z = gelu_vjp(d_h)[0]
+    d_b_in = jnp.sum(jnp.sum(d_z.astype(f32), axis=2), axis=0)
+    d_w_in = jnp.sum(
+        jnp.einsum("gecd,gecf->gedf", x, d_z, preferred_element_type=f32),
+        axis=0,
+    )
+    d_x = jnp.einsum("gecf,edf->gecd", d_z, w_in.astype(dt))
+    return (d_x, d_w_in.astype(w_in.dtype), d_b_in.astype(b_in.dtype),
+            d_w_out.astype(w_out.dtype), d_b_out.astype(b_out.dtype))
+
+
+_expert_ffn.defvjp(_expert_ffn_fwd, _expert_ffn_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ep_relayout(x, to_experts: bool):
+    """Custom-vjp wrapper of :func:`_moe_relayout_island`: the op is a
+    permutation of the global array, so its true VJP is the inverse
+    exchange. Spelling it out keeps autodiff off jax's all_to_all
+    transpose path (miscompiles for split != concat on some versions —
+    same guard as the pipeline trainer's ``_a2a_ep``) and off
+    shard_map's replication-rewrite rules."""
+    return _moe_relayout_island(x, to_experts)
+
+
+def _ep_relayout_fwd(x, to_experts):
+    return _ep_relayout(x, to_experts), None
+
+
+def _ep_relayout_bwd(to_experts, _, ct):
+    return (_moe_relayout_island(ct, not to_experts),)
+
+
+_ep_relayout.defvjp(_ep_relayout_fwd, _ep_relayout_bwd)
 
 
 class MoEFFN(nn.Module):
@@ -203,12 +419,24 @@ class MoEFFN(nn.Module):
     design: routing, dispatch, expert matmuls and combine are einsums
     over a (experts, capacity, d_model) layout — no per-expert Python,
     no dynamic shapes. Expert weights have a leading experts dim that
-    the sharding rules place on the ``ep`` mesh axis; under GSPMD the
-    dispatch einsum's operands (tokens sharded over dp, experts sharded
-    over ep) force the all-to-all, and the combine reverses it. The
-    switch load-balance loss is sown (pre-weighted by
-    ``moe_aux_weight``) into the ``losses`` collection; every trainer
-    adds sown losses to the objective.
+    the sharding rules place on the ``ep`` mesh axis.
+
+    Under the GSPMD sharded trainer (an ambient ``set_mesh`` mesh with
+    ep > 1) the dispatch and combine are EXPLICIT shard_map
+    all-to-alls (:func:`_ep_relayout`): the group partition is
+    mesh-anchored (one-plus routing groups per device,
+    :func:`moe_group_partition`), each ep member routes only its own
+    slice of the groups, a dispatch all_to_all ships its capacity
+    blocks to the owning expert shards, the experts run dense against
+    their local weights, and a combine all_to_all ships the outputs
+    back for the gate-weighted sum — no token replication, version-
+    independent, partitioner-proof. (Deriving the same movement from
+    einsum operand shardings — ``moe_ep_dispatch='replicate'`` — is
+    lowered by jax 0.4.x GSPMD to all-gather + all-reduce, O(world)
+    comm bytes and ~0.7% loss drift; kept only as the bench-moe
+    control leg.) The switch load-balance loss is sown (pre-weighted
+    by ``moe_aux_weight``) into the ``losses`` collection; every
+    trainer adds sown losses to the objective.
 
     Tokens route within fixed-size groups (``moe_group_size``), so the
     dispatch/combine one-hots stay linear in total tokens.
@@ -231,29 +459,55 @@ class MoEFFN(nn.Module):
     def __call__(self, x, token_w=None):
         import math
 
+        from sparktorch_tpu.parallel.sharding_rules import (
+            MOE_EXPERTS_BLOCKS_SPEC as _experts_spec,
+            MOE_GROUPS_BLOCKS_SPEC as _blocks_spec,
+            MOE_GROUPS_TOKENS_SPEC as _groups_spec,
+        )
+
         cfg = self.config
         dt = cfg.compute_dtype
         b, s, d = x.shape
         e = cfg.n_experts
         k = max(1, min(cfg.moe_top_k, e))
         n = b * s
-        # Largest group size <= moe_group_size dividing n (n and the
-        # bound are trace-time ints, so this loop is free).
-        g = min(n, max(1, cfg.moe_group_size))
-        while n % g:
-            g -= 1
-        n_groups = n // g
+        # The ambient GSPMD mesh (the sharded trainer) anchors the
+        # group partition and decides whether the explicit-a2a path
+        # engages; everywhere else (plain apply, shard_map trainers)
+        # mesh is None and the base partition applies.
+        mesh = ambient_gspmd_mesh()
+        sizes = dict(mesh.shape) if mesh is not None else {}
+        n_dev = 1
+        for v in sizes.values():
+            n_dev *= v
+        g, n_groups = moe_group_partition(
+            cfg, n, n_dev if mesh is not None else None
+        )
+        n_ep = sizes.get(AXIS_EP, 1)
+        n_shards = n_ep
+        for ax in BATCH_AXES:
+            n_shards *= sizes.get(ax, 1)
+        mode = cfg.moe_ep_dispatch
+        if mode not in ("auto", "a2a", "replicate"):
+            raise ValueError(f"unknown moe_ep_dispatch {mode!r}")
+        # Explicit dispatch/combine all-to-alls (trace-time decision —
+        # shapes are static): each ep member routes 1/ep of the groups
+        # and only its experts' capacity blocks ever cross the wire.
+        use_a2a = (
+            mesh is not None and n_ep > 1 and mode in ("auto", "a2a")
+            and e % n_ep == 0 and n_groups % n_shards == 0
+        )
+        if mode == "a2a" and mesh is not None and n_ep > 1 and not use_a2a:
+            raise ValueError(
+                f"moe_ep_dispatch='a2a' needs n_experts ({e}) divisible "
+                f"by ep={n_ep} and the routing group count ({n_groups}) "
+                f"divisible by dp*fsdp*ep={n_shards}; lower "
+                "moe_group_size or use 'auto'"
+            )
         tokens = x.reshape(n_groups, g, d)
         # GSPMD layout (active only under the sharded trainer's mesh):
-        # routing groups shard over EVERY data axis including ep —
-        # each ep member routes only its share of the groups — and the
-        # constraint on expert_in below (experts over ep) makes XLA
-        # insert the GShard dispatch all-to-all; the constraint on the
-        # combine output reverses it. See the pipeline trainer's
-        # _moe_ffn_ep_a2a for the same layout written as explicit
-        # collectives.
-        _groups_spec = P(BATCH_AXES + (AXIS_EP,), None, None)
-        _experts_spec = P(BATCH_AXES, AXIS_EP, None, None)
+        # routing groups shard over EVERY data axis including ep — each
+        # ep member routes only its share of the groups, device-locally.
         tokens = _gspmd_constraint(tokens, _groups_spec)
         # Static per-group capacity: ceil(cf * g * k / e) — scales with
         # the routing fan-out so k=2 doesn't halve effective capacity.
@@ -268,7 +522,7 @@ class MoEFFN(nn.Module):
             tokens.astype(jnp.float32)
         )                                            # (G, g, e)
         probs = jax.nn.softmax(logits, axis=-1)
-        topk_p, topk_idx = jax.lax.top_k(probs, k)   # (G, g, k)
+        topk_p, topk_idx = _top_k_routing(probs, k)  # (G, g, k)
         if k == 1:
             gates = topk_p                           # switch: raw prob
         else:
@@ -297,25 +551,33 @@ class MoEFFN(nn.Module):
         dispatch = jnp.any(disp, axis=2).astype(dt)  # (G, g, e, cap)
         expert_in = jnp.einsum("gnec,gnd->gecd", dispatch,
                                tokens.astype(dt))    # (G, e, cap, d)
-        expert_in = _gspmd_constraint(expert_in, _experts_spec)  # <- a2a
+        if use_a2a:
+            # Dispatch all-to-all: the member's locally-built capacity
+            # blocks travel to their experts' owners (groups layout ->
+            # experts layout; a global identity, see _ep_relayout).
+            expert_in = _gspmd_constraint(expert_in, _blocks_spec)
+            expert_in = _ep_relayout(expert_in, True)
+        expert_in = _gspmd_constraint(expert_in, _experts_spec)
         w_in = self.param("moe_w_in", nn.initializers.lecun_normal(),
                           (e, d, cfg.d_ff))
         b_in = self.param("moe_b_in", nn.initializers.zeros, (e, cfg.d_ff))
         w_out = self.param("moe_w_out", nn.initializers.lecun_normal(),
                            (e, cfg.d_ff, d))
         b_out = self.param("moe_b_out", nn.initializers.zeros, (e, d))
-        h = jnp.einsum("gecd,edf->gecf", expert_in, w_in.astype(dt))
-        h = nn.gelu(h + b_in[None, :, None].astype(dt))
-        h = _gspmd_constraint(h, _experts_spec)
-        expert_out = jnp.einsum("gecf,efd->gecd", h, w_out.astype(dt))
-        expert_out = expert_out + b_out[None, :, None].astype(dt)
+        expert_out = _expert_ffn(expert_in, w_in, b_in, w_out, b_out, dt)
         expert_out = _gspmd_constraint(expert_out, _experts_spec)
+        if use_a2a:
+            # Combine all-to-all: weighted-output blocks ship back to
+            # their groups' owners; the gate-weighted sum below then
+            # runs device-local on the member's own groups.
+            expert_out = _ep_relayout(expert_out, False)
+            expert_out = _gspmd_constraint(expert_out, _blocks_spec)
 
         # Gate-weighted combine over the kept (token, choice) slots.
         combine = jnp.einsum("gnk,gnkec->gnec", gates.astype(dt),
                              disp.astype(dt))        # (G, g, e, cap)
         out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
-        out = _gspmd_constraint(out, _groups_spec)   # <- combine a2a back
+        out = _gspmd_constraint(out, _groups_spec)   # <- groups layout
 
         # Switch load-balance loss over VALID tokens only: e * sum_e
         # frac_e * prob_e, where frac uses the primary (first) choice.

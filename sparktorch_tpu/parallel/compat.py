@@ -32,3 +32,37 @@ def set_mesh(mesh):
     if hasattr(jax, "set_mesh"):
         return jax.set_mesh(mesh)
     return mesh
+
+
+def ambient_gspmd_mesh():
+    """The ambient concrete :class:`~jax.sharding.Mesh` when we are in
+    GSPMD context, else None.
+
+    "GSPMD context" means a mesh is installed (``set_mesh`` / ``with
+    mesh:``) and NONE of its axis names is bound as a manual mapped
+    axis — inside a ``shard_map`` (or pmap) body every mesh axis is
+    Manual, sharding constraints are meaningless-to-wrong there, and
+    collective islands must not nest. The 0.4.x runtime has no
+    ``get_abstract_mesh``/axis-types API, so this is the one
+    version-portable detection point: the physical mesh comes off the
+    thread-local resource env that ``Mesh.__enter__`` installs, and
+    Manual-ness is probed through the trace-state axis env (a bound
+    axis name resolves; an unbound one raises NameError). Fails CLOSED:
+    any API drift returns None, which callers treat as "no mesh" — the
+    plain single-device code path, never a wrong collective."""
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        if mesh is None or mesh.empty:
+            return None
+        frame = jax.core.axis_frame  # AttributeError on newer jax -> closed
+        for name in mesh.axis_names:
+            try:
+                frame(name)
+                return None  # bound => Manual (shard_map/pmap body)
+            except NameError:
+                continue
+        return mesh
+    except Exception:  # noqa: BLE001 - fail closed across jax versions
+        return None

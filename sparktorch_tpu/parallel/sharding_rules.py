@@ -25,8 +25,37 @@ from sparktorch_tpu.parallel.mesh import (
     AXIS_EP,
     AXIS_FSDP,
     AXIS_TP,
+    BATCH_AXES,
     fsdp_param_sharding,
 )
+
+
+# ---------------------------------------------------------------------------
+# MoE dispatch/combine layouts (the shard_mapped all-to-all region)
+# ---------------------------------------------------------------------------
+#
+# The MoE hot path has exactly two layouts, and the dispatch/combine
+# all-to-alls are the relayout between them (models.transformer
+# ``_ep_relayout`` — an explicit shard_map island, NOT a partitioner-
+# derived reshard; jax 0.4.37's GSPMD lowers the constraint-derived
+# version to all-gather + all-reduce, full token replication):
+#
+# - GROUPS layout: routing groups shard over every batch axis AND ep —
+#   each ep member routes only its share of the groups. Routing,
+#   dispatch-plan construction and the gate-weighted combine all run
+#   here, fully device-local.
+# - EXPERTS layout: the experts dim shards over ep (groups stay over
+#   the batch axes only) — the dense expert FFN runs here, against the
+#   ep-sharded expert weights laid out by the param rules below.
+
+# (G, g, d) routed tokens / (G, g, e, cap) dispatch plans: groups over
+# dp+fsdp+ep, everything else local.
+MOE_GROUPS_TOKENS_SPEC = P(BATCH_AXES + (AXIS_EP,), None, None)
+# (G, e, cap, d) capacity blocks, groups layout (pre-dispatch /
+# post-combine side of the all-to-alls).
+MOE_GROUPS_BLOCKS_SPEC = P(BATCH_AXES + (AXIS_EP,), None, None, None)
+# (G, e, cap, d) capacity blocks, experts layout (the expert-FFN side).
+MOE_EXPERTS_BLOCKS_SPEC = P(BATCH_AXES, AXIS_EP, None, None)
 
 
 # (path regex, spec builder taking leaf ndim) — first match wins.

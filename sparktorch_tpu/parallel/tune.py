@@ -90,7 +90,14 @@ def transformer_caps(cfg, seq_len: Optional[int] = None) -> Dict[str, Tuple[int,
       rows ride ``P(tp, fsdp)``);
     - ``fsdp``: the model dim (the embedding's fsdp-sharded column);
     - ``sp``: the sequence length;
-    - ``ep``: the expert count (dense model -> ep stays 1);
+    - ``ep``: the expert count (dense model -> ep stays 1). The ep
+      axis is a first-class search dimension: dispatch/combine are
+      explicit shard_map all-to-alls with a mesh-anchored group
+      partition (models.transformer.MoEFFN), so measured ep candidates
+      reflect the real scaling layout, not the degraded partitioner-
+      derived lowering the pre-rewrite tuner had to distrust (the old
+      "defer ep re-validation" caveat is closed — stale entries are
+      fenced off by the cache-key schema bump);
     - ``pp``: the layer count.
     """
     return {
@@ -192,6 +199,12 @@ class WorkloadShape:
     n_layers: int = 1
     n_moe_layers: int = 0
     dtype_bytes: int = 4
+    # MoE capacity expansion: the dispatch/combine all-to-alls move
+    # (tokens x capacity_factor x top_k) capacity slots, not raw
+    # tokens — the a2a byte term scales by both (validated against the
+    # explicit shard_map lowering by `make bench-moe`).
+    moe_capacity_factor: float = 1.0
+    moe_top_k: int = 1
 
 
 def transformer_workload(cfg, global_batch: int,
@@ -215,6 +228,11 @@ def transformer_workload(cfg, global_batch: int,
         n_layers=cfg.n_layers,
         n_moe_layers=moe,
         dtype_bytes=dtype,
+        moe_capacity_factor=float(getattr(cfg, "capacity_factor", 1.0))
+        if moe else 1.0,
+        moe_top_k=int(max(1, min(getattr(cfg, "moe_top_k", 1),
+                                 cfg.n_experts)))
+        if moe else 1,
     )
 
 
@@ -397,8 +415,17 @@ def predict_comm_bytes(config: MeshConfig, shape: WorkloadShape,
         # sp: ring-attention k/v block rotation, (sp-1) hops per layer.
         "sp_ppermute": shape.n_layers * (sp - 1) * 2.0 * act_dev
         if sp > 1 else 0.0,
-        # ep: dispatch + combine all-to-alls per MoE layer.
-        "ep_all_to_all": shape.n_moe_layers * 2 * ((ep - 1) / ep) * act_dev
+        # ep: dispatch + combine all-to-alls per MoE layer. The
+        # explicit shard_map lowering (models.transformer._ep_relayout)
+        # exchanges (G, e, cap, d) CAPACITY blocks — tokens expanded by
+        # capacity_factor x top_k — with each member keeping its own
+        # 1/ep slice resident, hence the (ep-1)/ep wire fraction.
+        # Grounded against HLO-measured collective bytes and step wall
+        # by `make bench-moe` (the bench_moe_a2a gates).
+        "ep_all_to_all": (
+            shape.n_moe_layers * 2 * ((ep - 1) / ep) * act_dev
+            * shape.moe_capacity_factor * shape.moe_top_k
+        )
         if ep > 1 else 0.0,
         # pp: stage-boundary activation sends, fwd + bwd.
         "pp_send_recv": 2.0 * ((pp - 1) / pp) * act_dev if pp > 1 else 0.0,
@@ -924,8 +951,13 @@ def tune_cache_key(shape: WorkloadShape, caps: Mapping[str, Sequence[int]],
     doc = {
         # Bump when the cost model, scoring, or enumeration changes
         # behavior: an on-disk entry searched by obsolete logic must
-        # not satisfy the new version's key.
-        "schema": 1,
+        # not satisfy the new version's key. Schema 2: the MoE
+        # dispatch rewrite (explicit shard_map all-to-alls, mesh-
+        # anchored group partition, capacity-aware ep byte term) —
+        # entries measured under the degraded partitioner-derived
+        # lowering must not satisfy an ep search against the new one.
+        "schema": 2,
+        "moe_dispatch": "shard_map_a2a",
         "shape": dataclasses.asdict(shape),
         "caps": {k: sorted(int(x) for x in v) for k, v in caps.items()},
         "axes": list(axes),

@@ -374,12 +374,17 @@ def _moe_pattern(cfg: TransformerConfig):
 
 
 def _moe_groups(cfg: TransformerConfig, n: int) -> Tuple[int, int]:
-    """(group size, group count): largest g <= moe_group_size dividing
-    the token count (trace-time ints; shared by every MoE path)."""
-    g = min(n, max(1, cfg.moe_group_size))
-    while n % g:
-        g -= 1
-    return g, n // g
+    """(group size, group count) — the ONE group-partition definition
+    (models.transformer.moe_group_partition), un-anchored: inside the
+    pp shard_map the partition must depend only on (cfg, n) so ep
+    stays a pure layout choice at pinned step-0 exactness (the GSPMD
+    trainer's mesh-anchored variant would change the partition with
+    the mesh shape; its parity suite re-baselines both worlds
+    instead). The a2a layout therefore stays opt-in-by-group-size
+    here: pick moe_group_size so the group count divides ep."""
+    from sparktorch_tpu.models.transformer import moe_group_partition
+
+    return moe_group_partition(cfg, n)
 
 
 def _moe_route(cfg: TransformerConfig, mp, tokens, mask, cap: int):

@@ -81,28 +81,46 @@ def create_sharded_state(
         ]
 
     # Everything under set_mesh: tracing the module may hit the ring-
-    # attention shard_map island, which resolves the ambient mesh.
-    with _set_mesh(mesh):
-        abstract = jax.eval_shape(lambda k: module.init(k, sample_x), rng)
-        # _split_variables drops the write-only 'losses' collection
-        # (sown aux objectives), which must never live in the carried
-        # train state — see step().
-        a_params, a_state = _split_variables(abstract)
-        param_sh = shard_params(a_params, mesh, rules)
-        state_sh = jax.tree.map(lambda _: replicated(mesh), a_state)
+    # attention or MoE-dispatch shard_map islands, which resolve the
+    # ambient mesh.
+    #
+    # Layout-invariant init is a PARITY requirement: the default
+    # (non-partitionable) threefry lowering makes a jitted init's
+    # draws depend on the out_shardings, so an ep-sharded expert
+    # weight started at DIFFERENT values on an ep=2 mesh than on ep=1
+    # — the dominant term of the historical ~0.7% ep-parity drift
+    # (the MoE suite now pins ep=2 vs ep=1 at rtol 1e-5, which is
+    # impossible without this). Scoped tightly to the init jit: the
+    # train step itself draws no randoms, and the flag changes draw
+    # VALUES, so leaking it process-wide would silently shift every
+    # other trainer's seeds — hence set INSIDE the try whose finally
+    # restores it.
+    _old_threefry = jax.config.jax_threefry_partitionable
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+        with _set_mesh(mesh):
+            abstract = jax.eval_shape(lambda k: module.init(k, sample_x), rng)
+            # _split_variables drops the write-only 'losses' collection
+            # (sown aux objectives), which must never live in the carried
+            # train state — see step().
+            a_params, a_state = _split_variables(abstract)
+            param_sh = shard_params(a_params, mesh, rules)
+            state_sh = jax.tree.map(lambda _: replicated(mesh), a_state)
 
-        def init_all(key):
-            variables = module.init(key, sample_x)
-            params, mstate = _split_variables(variables)
-            opt_state = tx.init(params)
-            return params, mstate, opt_state
+            def init_all(key):
+                variables = module.init(key, sample_x)
+                params, mstate = _split_variables(variables)
+                opt_state = tx.init(params)
+                return params, mstate, opt_state
 
-        a_opt = jax.eval_shape(lambda k: init_all(k)[2], rng)
-        opt_sh = _opt_state_shardings(a_opt, a_params, param_sh, mesh)
+            a_opt = jax.eval_shape(lambda k: init_all(k)[2], rng)
+            opt_sh = _opt_state_shardings(a_opt, a_params, param_sh, mesh)
 
-        params, mstate, opt_state = jax.jit(
-            init_all, out_shardings=(param_sh, state_sh, opt_sh)
-        )(rng)
+            params, mstate, opt_state = jax.jit(
+                init_all, out_shardings=(param_sh, state_sh, opt_sh)
+            )(rng)
+    finally:
+        jax.config.update("jax_threefry_partitionable", _old_threefry)
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -41,7 +41,14 @@ except ImportError:  # pragma: no cover
 def shard_map_compat(fn, mesh, in_specs, out_specs):
     """shard_map with replication checking off, across the API rename
     (new keyword ``check_vma``; the legacy API spells it
-    ``check_rep``)."""
+    ``check_rep``). ``mesh=None`` means the ambient (set_mesh) mesh:
+    new jax resolves that natively, but 0.4.x requires the concrete
+    handle — resolve it here so island call sites (ring attention, the
+    MoE dispatch relayout) stay version-portable."""
+    if mesh is None:
+        from sparktorch_tpu.parallel.compat import ambient_gspmd_mesh
+
+        mesh = ambient_gspmd_mesh()
     try:
         return _shard_map_raw(fn, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_vma=False)

@@ -1,6 +1,6 @@
 """Regenerate the golden xprof trace fixtures.
 
-Two fixtures live beside this script:
+Three fixtures live beside this script:
 
 - ``synthetic_overlap.trace.json.gz`` — a handcrafted Chrome trace
   with EXACT known attribution (step walls, per-family unions, an
@@ -15,6 +15,12 @@ Two fixtures live beside this script:
   two all-reduces per step per device lane. Event COUNTS are
   deterministic for the frozen file; timings are whatever the
   generating machine did.
+- ``cpu_moe_a2a.trace.json.gz`` — a REAL capture of the GSPMD MoE
+  trainer on a dp4×ep2 mesh (write_moe_capture): 4 dispatch/combine
+  all-to-all HLOs × 8 device lanes × 3 steps, zero all-gathers.
+  tests/test_obs_xprof.py::test_moe_a2a_golden_capture_classification
+  asserts those counts EXACTLY — regenerate only in lockstep with it
+  (a different config silently breaks the golden pins).
 
 Regenerate (from the repo root):
 
@@ -119,6 +125,68 @@ def write_cpu_capture() -> str:
     return dst
 
 
+def write_moe_capture() -> str:
+    """Real capture of the GSPMD MoE trainer on a dp4 x ep2 mesh: the
+    explicit shard_map dispatch/combine all-to-alls must land in the
+    analyzer's comm lane (family ``all_to_all``), not "other" — the
+    frozen file pins the classification against a genuine ep=2
+    program's op spellings (jax 0.4.x CPU emits ``all-to-all-start``/
+    ``-done`` pairs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparktorch_tpu.models import CausalLM, tiny_transformer
+    from sparktorch_tpu.obs.telemetry import Telemetry
+    from sparktorch_tpu.parallel.compat import set_mesh
+    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+    from sparktorch_tpu.train.sharded import (
+        create_sharded_state,
+        make_sharded_train_step,
+        shard_batch,
+    )
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+    from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+    assert len(jax.devices()) == 8, "run with 8 forced CPU devices"
+    cfg = tiny_transformer(vocab_size=128, d_model=32, n_heads=2,
+                           n_layers=2, d_ff=64, max_len=32, n_experts=4,
+                           moe_every=2, moe_group_size=16)
+    mesh = build_mesh(MeshConfig(ep=2))
+    spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                     optimizer="adamw", optimizer_params={"lr": 1e-2})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (8, 17)).astype(np.int32)
+    batch = DataBatch(x=jnp.asarray(ids[:, :-1]), y=jnp.asarray(ids[:, 1:]),
+                      w=jnp.ones((8,), jnp.float32))
+    tx = spec.make_optimizer()
+    state, shardings = create_sharded_state(
+        spec, mesh, jax.random.key(0), sample_x=np.asarray(batch.x[:1]),
+        tx=tx,
+    )
+    step = make_sharded_train_step(
+        spec.make_module().apply, spec.loss_fn(), tx, mesh, shardings,
+    )
+    sharded = shard_batch(batch, mesh)
+    with set_mesh(mesh):
+        state, m = step.jitted(state, sharded)  # compile outside capture
+        jax.block_until_ready(m.loss)
+        tele = Telemetry(run_id="fixture_moe")
+        with tempfile.TemporaryDirectory() as d:
+            with profile_run(d, telemetry=tele, analyze=False):
+                for i in range(3):
+                    with step_annotation(i, telemetry=tele):
+                        state, m = step.jitted(state, sharded)
+                        jax.block_until_ready(m.loss)
+            (src,) = glob.glob(os.path.join(d, "**", "*.trace.json.gz"),
+                               recursive=True)
+            dst = os.path.join(HERE, "cpu_moe_a2a.trace.json.gz")
+            shutil.copyfile(src, dst)
+    return dst
+
+
 if __name__ == "__main__":
     print(write_synthetic())
     print(write_cpu_capture())
+    print(write_moe_capture())

@@ -133,6 +133,65 @@ def test_cost_model_terms_and_alpha():
     assert tp["dp_all_reduce"] < pure_dp["dp_all_reduce"]
     assert tp["tp_all_reduce"] > 0
     assert tp["collective_ops"] == 1 + 2 * 4
+
+
+def test_ep_a2a_byte_model_capacity_scaling():
+    """The ep dispatch/combine term models the EXPLICIT shard_map
+    lowering: (G, e, cap, d) capacity blocks — tokens expanded by
+    capacity_factor x top_k — with a (ep-1)/ep wire fraction. Linear
+    in both expansion knobs, zero at ep=1, monotone in ep; grounded
+    against measured HLO bytes by the bench-moe gate."""
+    import dataclasses
+
+    base = WorkloadShape(param_bytes=1e6, tp_param_bytes=1e6,
+                         global_batch=64, seq_len=32, d_model=128,
+                         n_layers=4, n_moe_layers=2, dtype_bytes=2,
+                         moe_capacity_factor=1.25, moe_top_k=2)
+    ep2 = predict_comm_bytes(MeshConfig(ep=2), base, 8)
+    assert ep2["ep_all_to_all"] > 0
+    # 2 a2as per MoE layer in the op count.
+    assert ep2["collective_ops"] == 1 + 2 * 2
+    # Linear in capacity_factor and top_k.
+    cf2 = dataclasses.replace(base, moe_capacity_factor=2.5)
+    assert predict_comm_bytes(MeshConfig(ep=2), cf2, 8)[
+        "ep_all_to_all"] == pytest.approx(2 * ep2["ep_all_to_all"])
+    k1 = dataclasses.replace(base, moe_top_k=1)
+    assert predict_comm_bytes(MeshConfig(ep=2), k1, 8)[
+        "ep_all_to_all"] == pytest.approx(ep2["ep_all_to_all"] / 2)
+    # No experts crossing the wire at ep=1; more ep -> more exposed.
+    assert predict_comm_bytes(MeshConfig(), base, 8)["ep_all_to_all"] == 0.0
+    ep4 = predict_comm_bytes(MeshConfig(ep=4), base, 8)
+    assert ep4["ep_all_to_all"] > ep2["ep_all_to_all"]
+
+
+def test_tune_cache_key_fences_pre_rewrite_ep_entries():
+    """The cache key carries the MoE dispatch generation (schema 2 +
+    shard_map_a2a marker) and the capacity knobs: a pre-rewrite entry
+    — or one searched under different expert capacity — can never
+    satisfy an ep search against the new lowering."""
+    import dataclasses
+
+    from sparktorch_tpu.models.transformer import tiny_transformer
+    from sparktorch_tpu.parallel.tune import tune_cache_key
+
+    cfg = tiny_transformer(n_experts=4, moe_top_k=2, capacity_factor=1.5)
+    shape = transformer_workload(cfg, 64)
+    # The workload shape carries the expansion knobs the a2a term uses.
+    assert shape.moe_capacity_factor == 1.5
+    assert shape.moe_top_k == 2
+    caps = transformer_caps(cfg)
+    devices = [object()]  # fingerprint only reads attrs defensively
+
+    def key(s):
+        return tune_cache_key(s, caps, ("dp", "ep"), devices,
+                              seq_sharded=False, measure_top_k=4,
+                              exposed_weight=0.25)
+
+    k = key(shape)
+    assert k != key(dataclasses.replace(shape, moe_capacity_factor=2.0))
+    assert k != key(dataclasses.replace(shape, moe_top_k=1))
+    # Same inputs -> same key (the cache still hits at all).
+    assert k == key(dataclasses.replace(shape))
     # The alpha term orders equal-byte configs by launch count.
     a0 = predict_comm_bytes(MeshConfig(tp=2), shape, 8, alpha_bytes=0)
     a1 = predict_comm_bytes(MeshConfig(tp=2), shape, 8,

@@ -69,11 +69,17 @@ def test_moe_trains_and_loss_decreases():
 
 def test_moe_ep_parity():
     # The SAME training run on an ep=1 vs ep=2 mesh must agree: expert
-    # parallelism is a layout choice, not a math choice. (The all-to-
-    # alls GSPMD inserts for ep=2 must not change the numbers.)
+    # parallelism is a layout choice, not a math choice. rtol 1e-5 is
+    # deliberately tight — the explicit dispatch/combine all-to-alls
+    # are a PERMUTATION of the global capacity blocks (numerics-proof
+    # by construction), the group partition is mesh-anchored so both
+    # worlds route identically, and layout-invariant init
+    # (threefry_partitionable, see create_sharded_state) starts both
+    # from the same parameters; the only residual is f32 reduction
+    # ordering in the cross-device grad sums.
     l1 = _run_steps(MeshConfig(ep=1), n_steps=6)
     l2 = _run_steps(MeshConfig(ep=2), n_steps=6)
-    np.testing.assert_allclose(l1, l2, rtol=2e-3)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
 
 def test_moe_aux_loss_joins_objective():
@@ -122,16 +128,15 @@ def test_moe_classifier_forward():
 
 def test_moe_top2_trains_and_ep_parity():
     """Top-2 routing (gate-weighted combine, choice-level capacity
-    priority) converges AND stays exact under expert parallelism."""
+    priority) converges AND stays exact under expert parallelism —
+    the explicit a2a dispatch keeps ep=2 a pure layout choice even at
+    k=2 (choice-priority capacity assignment is per-group, and every
+    group routes on exactly one device)."""
     l1 = _run_steps(MeshConfig(ep=1), n_steps=8, moe_top_k=2)
     assert all(np.isfinite(l1))
     assert l1[-1] < l1[0], l1
-    # rtol: bf16 rounding drift from the ep=2 all-to-all's different
-    # reduction order compounds over 8 adamw steps (~3e-3 by step 8);
-    # step-0 agreement is ~1e-5, so layouts do match.
     l2 = _run_steps(MeshConfig(ep=2), n_steps=8, moe_top_k=2)
-    np.testing.assert_allclose(l1[:1], l2[:1], rtol=1e-4)
-    np.testing.assert_allclose(l1, l2, rtol=6e-3)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
 
 def test_moe_drop_fraction_in_metrics():
@@ -194,13 +199,8 @@ def test_moe_padding_rows_masked_from_routing():
     assert "moe_drop_fraction" in r_pad.metrics[0]
 
 
-def test_moe_gspmd_ep_lowers_to_all_to_all():
-    """The GSPMD MoE layout constraints (transformer.py MoEFFN:
-    routing groups sharded over dp+ep, expert compute sharded over ep)
-    must make XLA insert REAL dispatch/combine all-to-alls — the
-    GShard scaling property, not token replication (VERDICT r04
-    item 2). Asserted on the compiled HLO of the actual train step."""
-    cfg = _moe_cfg(moe_group_size=16)  # several groups -> shardable
+def _compiled_ep2_hlo(**cfg_over):
+    cfg = _moe_cfg(**cfg_over)
     mesh = build_mesh(MeshConfig(ep=2))
     spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
                      optimizer="adamw", optimizer_params={"lr": 1e-2})
@@ -215,8 +215,88 @@ def test_moe_gspmd_ep_lowers_to_all_to_all():
     )
     batch = shard_batch(batch, mesh)
     with set_mesh(mesh):
-        hlo = step.jitted.lower(state, batch).compile().as_text()
+        return step.jitted.lower(state, batch).compile().as_text()
+
+
+def test_moe_gspmd_ep_lowers_to_all_to_all():
+    """The explicit shard_map dispatch (transformer.py MoEFFN /
+    _ep_relayout) must land REAL dispatch/combine all-to-alls in the
+    compiled ep=2 train step — the GShard scaling property, not token
+    replication (VERDICT r04 item 2). Asserted on the compiled HLO of
+    the actual train step."""
+    hlo = _compiled_ep2_hlo(moe_group_size=16)
     assert "all-to-all" in hlo, "no all-to-all in the ep=2 MoE step HLO"
+
+
+def test_moe_ep2_hlo_no_token_all_gather():
+    """HLO-lowering regression pin: the compiled ep=2 MoE step must
+    contain the dispatch/combine all-to-alls and NO all-gather — the
+    signature of jax 0.4.x GSPMD's degraded lowering of the
+    constraint-derived dispatch (all-gather + all-reduce = every token
+    replicated ep-fold). A future jax bump that re-degrades the
+    explicit shard_map lowering fails HERE, not as a silent comm/loss
+    regression. (The dp4xep2 mesh has no fsdp axis, so NOTHING in this
+    program should all-gather; the a2a count covers the MoE layer's
+    dispatch + combine in both the forward and the backward.)"""
+    from sparktorch_tpu.obs.xprof import hlo_collective_bytes
+
+    hlo = _compiled_ep2_hlo(moe_group_size=16)
+    stats = hlo_collective_bytes(hlo)
+    assert stats["counts"].get("all_to_all", 0) >= 4, stats
+    assert stats["counts"].get("all_gather", 0) == 0, (
+        "token all-gather resurfaced in the ep=2 MoE step HLO — the "
+        f"partitioner is replicating tokens again: {stats}"
+    )
+    assert stats["bytes"]["all_to_all"] > 0, stats
+
+
+def test_moe_drop_accounting_exact_across_ep():
+    """Capacity-overflow drop accounting must be EXACT under expert
+    parallelism: at a starving capacity factor, the global (dropped,
+    routed) counts an ep=2 run reports must equal the ep=1 run's
+    bitwise (both integer-valued f32 sums over identical per-group
+    routing — the mesh-anchored partition routes the same groups on
+    both worlds), and routed == n_tokens * k exactly (all weights 1),
+    so the reported fraction times n*k must be a whole number of
+    dropped choices."""
+    def drop_fraction_at(mesh_cfg):
+        cfg = _moe_cfg(capacity_factor=0.25, moe_top_k=2)
+        mesh = build_mesh(mesh_cfg)
+        spec = ModelSpec(module=CausalLM(cfg), loss="cross_entropy",
+                         optimizer="sgd", optimizer_params={"lr": 0.0})
+        batch = _lm_batch(cfg)
+        tx = spec.make_optimizer()
+        state, shardings = create_sharded_state(
+            spec, mesh, jax.random.key(0), sample_x=np.asarray(batch.x[:1]),
+            tx=tx,
+        )
+        step = make_sharded_train_step(
+            spec.make_module().apply, spec.loss_fn(), tx, mesh, shardings
+        )
+        _, metrics = step(state, shard_batch(batch, mesh))
+        return float(metrics.drop_fraction)
+
+    f1 = drop_fraction_at(MeshConfig(ep=1))
+    f2 = drop_fraction_at(MeshConfig(ep=2))
+    assert f1 == f2, (f1, f2)  # bitwise: same routing, exact counts
+    n_choices = 8 * 16 * 2  # b * s * top_k, every token weight 1
+    dropped = f1 * n_choices
+    assert abs(dropped - round(dropped)) < 1e-6, (f1, dropped)
+    assert 0.0 < f1 < 1.0, f1
+
+
+def test_moe_seed_determinism_across_ep_worlds():
+    """Same seed -> bitwise-identical loss trajectories, per ep world
+    (rerunning ep=2 must reproduce itself exactly — the a2a dispatch
+    introduces no nondeterminism), and across worlds the seed yields
+    the same parity the rtol gates pin."""
+    a = _run_steps(MeshConfig(ep=2), n_steps=4, seed=3)
+    b = _run_steps(MeshConfig(ep=2), n_steps=4, seed=3)
+    assert a == b, (a, b)
+    c = _run_steps(MeshConfig(ep=1), n_steps=4, seed=3)
+    d = _run_steps(MeshConfig(ep=1), n_steps=4, seed=3)
+    assert c == d, (c, d)
+    np.testing.assert_allclose(a, c, rtol=1e-5)
 
 
 def test_moe_sp_ep_composition_parity():
