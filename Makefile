@@ -7,7 +7,8 @@ PYTHON ?= python
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
 	bench-ps-fleet bench-tune bench-rpc-trace bench-serve \
-	bench-elastic bench-obs-history bench-moe cluster-up clean lint-obs
+	bench-elastic bench-obs-history bench-moe bench-goodput \
+	cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -93,6 +94,17 @@ lint-obs:
 		echo "lint-obs: raw time.time() outside obs/ (durations use"; \
 		echo "time.perf_counter(); wall-clock timestamps go through"; \
 		echo "obs.telemetry.wall_ts, or annotate 'lint-obs: ok (<why>)'):"; \
+		echo "$$hits"; exit 1; \
+	fi; \
+	hits=$$(grep -rn --include='*.py' -E 'time\.perf_counter\(' \
+		sparktorch_tpu/train/ sparktorch_tpu/ctl/ \
+		| grep -v 'lint-obs: ok'); \
+	if [ -n "$$hits" ]; then \
+		echo "lint-obs: raw perf_counter timing in train/ or ctl/"; \
+		echo "(measured regions go through obs.goodput LedgerSpans so"; \
+		echo "the run-level time ledger stays MECE — use"; \
+		echo "goodput.span/step_span and read .duration_s, or annotate"; \
+		echo "a control-flow clock with 'lint-obs: ok (<why>)'):"; \
 		echo "$$hits"; exit 1; \
 	fi; echo "lint-obs OK"
 
@@ -279,6 +291,27 @@ bench-elastic:
 bench-obs-history:
 	$(PYTHON) -m sparktorch_tpu.bench --config obs_history \
 		--log benchmarks/bench_r09_obs.jsonl
+
+# Goodput-ledger gate: the run-level time ledger must be MECE on a
+# real multi-process elastic run — buckets (compute/exposed_comm/
+# compile/checkpoint/data_wait/restart_downtime/resize_downtime/idle)
+# sum to total run wall within 2% with ZERO over-attribution; a seeded
+# non-cooperative kill must land at least its measured recovery gap in
+# restart_downtime (the ledger reconciles with ft_recovery_latency_s
+# by construction) and the shrink must land in resize_downtime; a
+# seeded 0.5s slow-shard must shift exposed_comm, NOT compute, on the
+# hogwild wire leg; a training leg must show compile, checkpoint and
+# data_wait as nonzero numbers with `GET /goodput` serving the run
+# report over HTTP and `timeline --goodput` naming the biggest thief;
+# and ledger overhead must stay under 1% of step wall — FAILS
+# otherwise. The record is retained (--log) so the overhead drift gate
+# arms against the windowed median of prior rounds
+# (SPARKTORCH_TPU_GOODPUT_DRIFT_TOL, relative, default 1.0). An A/A
+# leg (no chaos) must report exactly zero downtime seconds. Runs on
+# any backend (JAX_PLATFORMS=cpu works).
+bench-goodput:
+	$(PYTHON) -m sparktorch_tpu.bench --config goodput \
+		--log benchmarks/bench_r11_goodput.jsonl
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

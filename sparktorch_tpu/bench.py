@@ -51,7 +51,15 @@ from sparktorch_tpu.obs.telemetry import wall_ts
 REFERENCE_BASELINE_EXAMPLES_PER_SEC = 1120.8
 
 # v5e single-chip peaks, for MFU/roofline fields (public spec values).
-V5E_BF16_PEAK_TFLOPS = 197.0
+# The bf16 peak lives in obs.goodput (single source: the run-level
+# goodput ledger's MFU and the bench headline use the SAME constant
+# and the same mfu_honest division, so /goodput and a bench record can
+# never disagree on the formula).
+from sparktorch_tpu.obs.goodput import (  # noqa: E402
+    V5E_BF16_PEAK_TFLOPS,
+    mfu_honest as _mfu_honest,
+)
+
 V5E_HBM_GB_PER_S = 819.0
 
 
@@ -3439,6 +3447,394 @@ def bench_obs_history(n_pulls: int = 6, slow_delay_s: float = 0.5,
     }
 
 
+def bench_goodput(n_parts: int = 10, part_sleep_s: float = 0.25,
+                  n_pulls: int = 4, slow_delay_s: float = 0.5) -> dict:
+    """Run-level goodput-ledger gate (``make bench-goodput``) — FAILS
+    (raises) unless the time ledger's four claims hold end to end:
+
+    - **attribution is real**: a streaming training run with
+      checkpointing shows ``compile`` (init + first-chunk cache miss),
+      ``checkpoint`` and ``data_wait`` as nonzero seconds, with the
+      MECE invariant holding (buckets + idle sum to wall within 2%,
+      ZERO over-attribution) and the run report served per rank and
+      run-wide over ``GET /goodput`` + rendered by
+      ``timeline --goodput`` with the biggest thief named;
+    - **chaos lands in the right bucket**: a seeded ``slow_shard_s``
+      delay on the hogwild wire shifts ``exposed_comm``, NOT
+      ``compute``, vs an A/A control leg (whose downtime buckets are
+      exactly zero);
+    - **downtime reconciles**: on a real multi-process elastic run, a
+      seeded non-cooperative kill lands at least its measured recovery
+      gap in ``restart_downtime`` (the bucket is fed from the same
+      detection->relaunch window ``ft_recovery_latency_s`` measures,
+      so the two reconcile to a tolerance), the shrink+grow walls land
+      in ``resize_downtime``, and the driver ledger stays MECE;
+    - **the ledger is nearly free**: one LedgerSpan costs < 1% of the
+      measured training step wall (drift-gated against the windowed
+      median of prior rounds, ``SPARKTORCH_TPU_GOODPUT_DRIFT_TOL``).
+    """
+    import contextlib
+    import io
+    import os
+    import tempfile
+    import threading
+
+    import jax
+
+    from sparktorch_tpu.ctl import ElasticController, spawn_worker
+    from sparktorch_tpu.ft import ChaosConfig, FtPolicy, RestartPolicy, inject
+    from sparktorch_tpu.models import MnistMLP
+    from sparktorch_tpu.native.gang import GangCoordinator, GangMetricsExporter
+    from sparktorch_tpu.net.sharded import ShardedTransport
+    from sparktorch_tpu.obs import FleetCollector, Telemetry
+    from sparktorch_tpu.obs import goodput as _goodput
+    from sparktorch_tpu.obs import timeline as _timeline
+    from sparktorch_tpu.obs.collector import scrape_json
+    from sparktorch_tpu.serve.fleet import ParamServerFleet
+    from sparktorch_tpu.train.sync import train_distributed_streaming
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    t_start = time.perf_counter()
+    workdir = tempfile.mkdtemp(prefix="bench_goodput_")
+
+    def _mece(doc: dict, leg: str, over_tol_frac: float = 0.02) -> None:
+        wall = float(doc["wall_s"])
+        total = sum(float(v) for v in doc["buckets"].values())
+        if abs(total - wall) > 0.02 * wall:
+            raise AssertionError(
+                f"{leg}: ledger not MECE — buckets sum {total:.3f}s vs "
+                f"wall {wall:.3f}s (> 2%)")
+        if float(doc["overattributed_s"]) > over_tol_frac * wall:
+            raise AssertionError(
+                f"{leg}: {doc['overattributed_s']}s over-attributed "
+                f"(double-counted regions) against {wall:.3f}s wall")
+
+    def _zero_downtime(doc: dict, leg: str) -> None:
+        for b in ("restart_downtime", "resize_downtime"):
+            if float(doc["buckets"][b]) != 0.0:
+                raise AssertionError(
+                    f"{leg}: A/A run shows nonzero {b} "
+                    f"({doc['buckets'][b]}s)")
+
+    # -- leg 1: training attribution (compile/checkpoint/data_wait) ----
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2048, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (2048,)).astype(np.int32)
+    spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                     optimizer="sgd", optimizer_params={"lr": 1e-2},
+                     input_shape=(784,))
+    tele0 = Telemetry(run_id="bench_goodput_r0")
+    ledger0 = _goodput.GoodputLedger(telemetry=tele0, rank=0)
+    with ledger0.activate():
+        train_distributed_streaming(
+            spec, (x, y), chunk_rows=512, epochs=2, mini_batch=64,
+            checkpoint_dir=os.path.join(workdir, "ckpt"),
+            checkpoint_every=8, telemetry=tele0,
+        )
+    doc0 = tele0.get_section(_goodput.SECTION)
+    _mece(doc0, "training leg")
+    _zero_downtime(doc0, "training leg")
+    for bucket, floor in (("compile", 0.01), ("checkpoint", 0.001),
+                          ("data_wait", 0.0005), ("compute", 0.001)):
+        if float(doc0["buckets"][bucket]) <= floor:
+            raise AssertionError(
+                f"training leg: {bucket} bucket empty "
+                f"({doc0['buckets'][bucket]}s <= {floor}s floor) — "
+                f"instrumentation lost: {doc0['buckets']}")
+    if doc0["compiles"] < 2 or doc0["n_steps"] <= 0:
+        raise AssertionError(
+            f"training leg: compiles {doc0['compiles']} (want >= 2: "
+            f"init + first chunk) / n_steps {doc0['n_steps']}")
+    step_wall_s = ((float(doc0["buckets"]["compute"])
+                    + float(doc0["buckets"]["exposed_comm"]))
+                   / doc0["n_steps"])
+
+    # Rank 1: a second, flops-declared ledger (a jitted matmul loop),
+    # so the merged /goodput report is genuinely per-rank and carries
+    # MFU.
+    tele1 = Telemetry(run_id="bench_goodput_r1")
+    m = 256
+    mm = jax.jit(lambda a: a @ a)
+    xm = np.ones((m, m), np.float32)
+    ledger1 = _goodput.GoodputLedger(telemetry=tele1, rank=1,
+                                     flops_per_step=2.0 * m ** 3)
+    for _ in range(5):
+        c0 = _goodput.jit_cache_size(mm)
+        with ledger1.step_span() as sp:
+            mm(xm).block_until_ready()
+            c1 = _goodput.jit_cache_size(mm)
+            if c0 is not None and c1 is not None and c1 > c0:
+                sp.rebucket("compile")
+    ledger1.set_comm_model(0.1, "estimate")
+    doc1 = ledger1.close()
+    _mece(doc1, "rank1 leg")
+
+    # -- leg 2: collector merge, GET /goodput, timeline renders --------
+    exp0 = GangMetricsExporter(telemetry=tele0, port=0).start()
+    exp1 = GangMetricsExporter(telemetry=tele1, port=0).start()
+    sink = os.path.join(workdir, "collector_sink.jsonl")
+    collector = FleetCollector({0: exp0.url, 1: exp1.url},
+                               poll_interval_s=0, jsonl_path=sink)
+    collector.start(poll_loop=False)
+    try:
+        collector.poll()
+        run_doc = scrape_json(f"{collector.url}/goodput")
+    finally:
+        collector.stop()
+        exp0.stop()
+        exp1.stop()
+    ranks_seen = set(run_doc.get("per_rank") or {})
+    if not {"0", "1"} <= ranks_seen:
+        raise AssertionError(
+            f"/goodput per_rank missing ranks: {sorted(ranks_seen)}")
+    if not (0.0 < float(run_doc["goodput"]) <= 1.0) or \
+            any("goodput" not in r for r in run_doc["per_rank"].values()):
+        raise AssertionError(
+            f"/goodput fractions malformed: run {run_doc.get('goodput')}")
+    thief = run_doc.get("biggest_thief")
+    if not thief or thief["bucket"] == "compute":
+        raise AssertionError(f"/goodput biggest_thief missing: {thief}")
+    if run_doc.get("mfu") is None:
+        raise AssertionError("/goodput lacks mfu despite a flops-"
+                             "declaring rank")
+    expected_thief = max(
+        ((b, s) for b, s in run_doc["buckets"].items() if b != "compute"),
+        key=lambda kv: kv[1])[0]
+    for args_, what in ((["--goodput", sink], "collector sink"),
+                        ([ "--goodput", os.path.join(workdir,
+                                                     "goodput.json")],
+                         "saved /goodput doc")):
+        if what == "saved /goodput doc":
+            with open(args_[1], "w") as f:
+                f.write(json.dumps(run_doc))
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = _timeline.main(args_)
+        out_txt = buf.getvalue()
+        if rc != 0 or f"biggest thief: {expected_thief}" not in out_txt:
+            raise AssertionError(
+                f"timeline --goodput ({what}) failed (rc={rc}) or did "
+                f"not name the biggest thief {expected_thief!r}:\n"
+                f"{out_txt[:800]}")
+
+    # -- leg 3: chaos slow shard shifts exposed_comm, not compute ------
+    wire_spec = ModelSpec(module=MnistMLP(), loss="cross_entropy",
+                          optimizer="sgd", optimizer_params={"lr": 1e-2},
+                          input_shape=(784,))
+    wf = jax.jit(lambda a: (a @ a).sum())
+    wx = np.ones((512, 512), np.float32)
+    wf(wx).block_until_ready()  # compiled OUTSIDE any leg's ledger
+
+    def _wire_leg(chaos_cfg) -> dict:
+        leg_tele = Telemetry(run_id="bench_goodput_wire")
+        fleet = ParamServerFleet(wire_spec, n_shards=2,
+                                 telemetry=leg_tele).start()
+        ledger = _goodput.GoodputLedger(telemetry=leg_tele, rank="wire")
+        try:
+            transport = ShardedTransport(fleet, telemetry=leg_tele)
+            have = -1
+            ctx = (inject(chaos_cfg, telemetry=leg_tele) if chaos_cfg
+                   else contextlib.nullcontext())
+            with ctx:
+                for _ in range(n_pulls):
+                    with ledger.span("exposed_comm", {"site": "pull"}):
+                        snap = transport.pull(have)
+                        if snap is not None:
+                            have = snap[0]
+                    with ledger.step_span():
+                        wf(wx).block_until_ready()
+            transport.close()
+            return ledger.close()
+        finally:
+            fleet.stop()
+
+    wire_ctrl = _wire_leg(None)
+    wire_chaos = _wire_leg(ChaosConfig(
+        seed=7, slow_shard_s={"1": slow_delay_s}))
+    _zero_downtime(wire_ctrl, "wire control leg")
+    _zero_downtime(wire_chaos, "wire chaos leg")
+    injected = slow_delay_s * n_pulls
+    comm_shift = (float(wire_chaos["buckets"]["exposed_comm"])
+                  - float(wire_ctrl["buckets"]["exposed_comm"]))
+    if comm_shift < 0.8 * injected:
+        raise AssertionError(
+            f"seeded slow shard did not land in exposed_comm: shift "
+            f"{comm_shift:.3f}s vs {injected:.3f}s injected")
+    compute_shift = (float(wire_chaos["buckets"]["compute"])
+                     - float(wire_ctrl["buckets"]["compute"]))
+    if compute_shift > 0.25 * injected:
+        raise AssertionError(
+            f"seeded slow shard leaked into compute: +"
+            f"{compute_shift:.3f}s (vs {injected:.3f}s injected — the "
+            f"delay must land in exposed_comm)")
+
+    # -- leg 4: elastic downtime attribution + reconciliation ----------
+    out = os.path.join(workdir, "parts")
+    hb_dir = os.path.join(workdir, "hb")
+    os.makedirs(out)
+    work = [f"part{i:03d}" for i in range(n_parts)]
+
+    def completed(p):
+        return os.path.exists(os.path.join(out, p + ".done"))
+
+    etele = Telemetry(run_id="bench_goodput_elastic")
+
+    def start_fn(rank, attempt, generation, assignment):
+        def workfn(ctx, _parts=tuple(assignment), _rank=rank,
+                   _gen=generation, _out=out, _sleep=part_sleep_s):
+            import os as _os
+            import time as _t
+
+            if _rank == 1:
+                raise RuntimeError("rank1 permanently broken")
+            for i, p in enumerate(_parts):
+                if ctx.should_stop():
+                    return
+                ctx.notify_step(i)
+                path = _os.path.join(_out, p + ".done")
+                if _os.path.exists(path):
+                    continue
+                tmp = path + f".tmp{_os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(f"{_rank}:{_gen}")
+                _os.replace(tmp, path)
+                _t.sleep(_sleep)
+
+        return spawn_worker(workfn, rank=rank, heartbeat_dir=hb_dir,
+                            name=f"rank{rank}", telemetry=etele)
+
+    coord = GangCoordinator(world_size=3, port=0,
+                            heartbeat_timeout_ms=30_000)
+    policy = FtPolicy(restart=RestartPolicy(max_restarts=2,
+                                            backoff_base_s=0.05,
+                                            backoff_max_s=0.2), seed=0)
+    ctl = ElasticController(work, completed, policy=policy,
+                            telemetry=etele, coordinator=coord,
+                            min_world=1, name="bench_goodput")
+    for r in range(3):
+        ctl.add_rank(r, start_fn)
+
+    def grower():
+        deadline = time.perf_counter() + 120.0
+        while time.perf_counter() < deadline and not ctl._stop.is_set():
+            if ctl._resizes["shrink"] >= 1:
+                ctl.grow(3, start_fn)
+                return
+            time.sleep(0.05)
+
+    threading.Thread(target=grower, daemon=True).start()
+    eledger = _goodput.GoodputLedger(telemetry=etele, rank="driver")
+    try:
+        with eledger.activate():
+            with inject(ChaosConfig(seed=11, kill_process_at={0: 2}),
+                        telemetry=etele) as inj:
+                summary = ctl.run(poll_interval_s=0.05, deadline_s=240.0)
+    finally:
+        coord.stop()
+    edoc = etele.get_section(_goodput.SECTION)
+    _mece(edoc, "elastic leg")
+    missing = [p for p in work if not completed(p)]
+    if missing or summary["work_pending"]:
+        raise AssertionError(f"elastic leg incomplete: {missing}")
+    kills = [e for e in inj.events if e["site"] == "ctl.process"]
+    if len(kills) != 1 or summary["resizes"] != {"shrink": 1, "grow": 1}:
+        raise AssertionError(
+            f"elastic leg chaos schedule wrong: kills {kills}, "
+            f"resizes {summary['resizes']}")
+    recovery = [
+        (v["sum"], v["max"]) for k, v in
+        etele.snapshot()["histograms"].items()
+        if k.startswith("ft_recovery_latency_s") and v["count"]
+    ]
+    if not recovery:
+        raise AssertionError("no ft_recovery_latency_s samples")
+    recovery_sum = sum(s for s, _ in recovery)
+    recovery_max = max(mx for _, mx in recovery)
+    restart_bucket = float(edoc["buckets"]["restart_downtime"])
+    if restart_bucket < recovery_max:
+        raise AssertionError(
+            f"seeded kill's measured gap {recovery_max:.3f}s not "
+            f"covered by restart_downtime {restart_bucket:.3f}s")
+    if abs(restart_bucket - recovery_sum) > 0.05 * recovery_sum + 0.05:
+        raise AssertionError(
+            f"restart_downtime {restart_bucket:.3f}s does not "
+            f"reconcile with ft_recovery_latency_s sum "
+            f"{recovery_sum:.3f}s (same event window)")
+    resize_bucket = float(edoc["buckets"]["resize_downtime"])
+    if resize_bucket <= 0 or edoc["counts"].get("resize_downtime", 0) != 2:
+        raise AssertionError(
+            f"shrink+grow not attributed: resize_downtime "
+            f"{resize_bucket}s x{edoc['counts'].get('resize_downtime')}")
+
+    # -- leg 5: ledger overhead vs step wall + drift gate --------------
+    bare = _goodput.GoodputLedger(telemetry=None)
+    reps = 5000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with bare.step_span():
+            pass
+    span_cost_s = (time.perf_counter() - t0) / reps
+    span_us = span_cost_s * 1e6
+    overhead_frac = span_cost_s / max(step_wall_s, 1e-9)
+    if overhead_frac >= 0.01:
+        raise AssertionError(
+            f"ledger span overhead {span_us:.2f}us is "
+            f"{100 * overhead_frac:.2f}% of the measured "
+            f"{step_wall_s * 1e3:.3f}ms step wall (bound: 1%)")
+
+    tol = float(os.environ.get("SPARKTORCH_TPU_GOODPUT_DRIFT_TOL", "1.0"))
+    prior = _prior_window("goodput", "ledger_span_us", k=3)
+    if prior is None:
+        drift = {"status": "no_prior_record", "tolerance": tol}
+    else:
+        drift = {
+            "status": "checked", "tolerance": tol,
+            "prior_median_us": round(prior["median"], 3),
+            "prior_n": prior["n"],
+            "ratio": round(span_us / max(prior["median"], 1e-9), 3),
+        }
+        if span_us > prior["median"] * (1.0 + tol) + 2.0:
+            raise AssertionError(
+                f"ledger span cost regressed: {span_us:.2f}us vs prior "
+                f"windowed median {prior['median']:.2f}us (past the "
+                f"{tol} relative tolerance + 2us floor); drift: {drift}")
+
+    return {
+        "config": "goodput", "unit": "us (LedgerSpan overhead)",
+        "value": round(span_us, 3),
+        "ledger_span_us": round(span_us, 3),
+        "overhead_pct_of_step": round(100 * overhead_frac, 4),
+        "step_wall_ms": round(step_wall_s * 1e3, 3),
+        "training": {
+            "buckets": doc0["buckets"],
+            "goodput": doc0["goodput"],
+            "compiles": doc0["compiles"],
+            "n_steps": doc0["n_steps"],
+        },
+        "run_report": {
+            "goodput": run_doc["goodput"],
+            "n_ranks": run_doc["n_ranks"],
+            "biggest_thief": run_doc.get("biggest_thief"),
+            "comm_source": run_doc.get("comm_source"),
+            "mfu": run_doc.get("mfu"),
+        },
+        "wire": {
+            "injected_s": injected,
+            "exposed_comm_shift_s": round(comm_shift, 3),
+            "compute_shift_s": round(compute_shift, 3),
+        },
+        "elastic": {
+            "restart_downtime_s": round(restart_bucket, 3),
+            "recovery_latency_sum_s": round(recovery_sum, 3),
+            "resize_downtime_s": round(resize_bucket, 3),
+            "goodput": edoc["goodput"],
+            "resizes": summary["resizes"],
+        },
+        "goodput_drift": drift,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+    }
+
+
 def _bert_flops_accounting(module, batch: int, seq: int) -> dict:
     """Honest model-FLOPs accounting for the BERT classifier.
 
@@ -3526,7 +3922,7 @@ def bench_bert_dp() -> dict:
         "n_params_embedding": acct["n_params_embedding"],
         "n_params_per_token": acct["n_params_per_token"],
         "achieved_tflops_per_chip": round(honest, 2),
-        "mfu_honest": round(honest / V5E_BF16_PEAK_TFLOPS, 4),
+        "mfu_honest": round(_mfu_honest(honest), 4),
         "achieved_tflops_6n_total_legacy": round(
             _tflops(acct["legacy_6n_total_flops_per_step"]), 2
         ),
@@ -4024,6 +4420,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "hogwild_chaos_soak": bench_hogwild_chaos_soak,
     "elastic_ctl": bench_elastic_ctl,
     "obs_history": bench_obs_history,
+    "goodput": bench_goodput,
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "serve_online": bench_serve_online,
     "rpc_trace": bench_rpc_trace,

@@ -127,10 +127,23 @@ def build_context(payload: Dict[str, Any]) -> WorkerContext:
         with open(tmp, "w") as f:  # lint-obs: ok (url handoff, not telemetry)
             f.write(exporter.url)
         os.replace(tmp, url_path)
+    # Every process worker keeps a goodput ledger beside its flight
+    # recorder: installed ambient, so the instrumentation in train/,
+    # serve/ and utils/checkpoint attributes into it, and its
+    # ``goodput`` section rides the same /telemetry scrape — the
+    # collector's run-level /goodput merge (and a postmortem's
+    # goodput-at-death block) is built from these per-rank ledgers.
+    from sparktorch_tpu.obs import goodput as _goodput
+
+    ledger = _goodput.GoodputLedger(telemetry=telemetry, rank=rank)
+    ledger.start_auto_publish()
+    ledger.publish()  # section visible from the FIRST scrape
+    _goodput.install(ledger)
     ctx = WorkerContext(name, rank, cancel, heartbeat=heartbeat,
                         telemetry=telemetry, ctl=ctl)
     ctx._exporter = exporter  # kept alive for the process lifetime
     ctx._recorder = recorder
+    ctx.ledger = ledger
     return ctx
 
 
@@ -195,6 +208,13 @@ def main(argv: Optional[list] = None) -> int:
     finally:
         if ctx.heartbeat is not None:
             ctx.heartbeat.close()
+        # Final ledger publish: the closing accounting lands on the
+        # exporter's snapshot for whoever scrapes the corpse (a
+        # SIGKILLed worker never reaches here — its last THROTTLED
+        # publish is what the collector's last-good snapshot holds).
+        ledger = getattr(ctx, "ledger", None)
+        if ledger is not None:
+            ledger.close()
     # A normal return is a fulfilled contract (entry fns drain by
     # returning early, with idempotent skip-on-restart semantics) —
     # exit 0 even when cancel fired late in the run.

@@ -24,6 +24,11 @@ from sparktorch_tpu.obs.blackbox import (
     collect_postmortem,
     read_postmortem,
 )
+from sparktorch_tpu.obs.goodput import (
+    GoodputLedger,
+    LedgerSpan,
+    mfu_honest,
+)
 from sparktorch_tpu.obs.sinks import JsonlSink, read_jsonl, write_jsonl
 from sparktorch_tpu.obs.prom import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
@@ -78,6 +83,9 @@ __all__ = [
     "attach_recorder",
     "collect_postmortem",
     "read_postmortem",
+    "GoodputLedger",
+    "LedgerSpan",
+    "mfu_honest",
     "JsonlSink",
     "read_jsonl",
     "write_jsonl",

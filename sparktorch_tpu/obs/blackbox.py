@@ -240,6 +240,24 @@ def collect_postmortem(out_dir: str, reason: str,
         section = telemetry.get_section("elastic")
         if isinstance(section, Mapping):
             world = dict(section)
+    # The run's last goodput accounting rides the bundle: a dead run's
+    # time ledger (how much of the run was productive, who stole the
+    # rest) must survive exactly like its event ring does. The
+    # collector's merged run doc wins (it folds every scraped rank's
+    # last-good ledger); a driver-local ledger section is the fallback.
+    goodput = None
+    if collector is not None:
+        try:
+            goodput = collector.goodput_view()
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            goodput = None
+    if goodput is None and telemetry is not None:
+        from sparktorch_tpu.obs import goodput as _goodput_mod
+
+        section = (telemetry.get_section(_goodput_mod.RUN_SECTION)
+                   or telemetry.get_section(_goodput_mod.SECTION))
+        if isinstance(section, Mapping):
+            goodput = dict(section)
     # Dedup (the controller's history events also flow through its
     # bus recorder) and order: identical (ts, kind, rank) triples
     # collapse, the narrative reads in time order. The controller's
@@ -274,6 +292,7 @@ def collect_postmortem(out_dir: str, reason: str,
         "n_events": len(unique),
         "events": unique,
         "metric_deltas": deltas,
+        "goodput": goodput,
         "rpc_traces": rpc_traces,
         "heartbeats": heartbeats,
         "world": world,

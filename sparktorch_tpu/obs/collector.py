@@ -455,6 +455,7 @@ class FleetCollector:
                 )
         self._merge_xprof()
         self._stitch_rpc()
+        self._merge_goodput()
         merged = self.merged_snapshot()
         alert_events: List[Dict[str, Any]] = []
         if self.history is not None:
@@ -475,9 +476,25 @@ class FleetCollector:
                 # records BEFORE the snapshot: a `timeline --follow`
                 # tail renders the firing the moment it happens, and
                 # the HA fallback secondary replays the same episodes.
+                # The run-level goodput accounting rides the same way
+                # — one condensed `goodput.run` record per sweep (the
+                # shape `--follow` renders as a one-liner), with the
+                # full document still on the snapshot's sections.
+                goodput_records: List[Dict[str, Any]] = []
+                run_doc = (merged.get("sections") or {}).get("goodput_run")
+                if isinstance(run_doc, Mapping):
+                    goodput_records.append({
+                        "kind": "goodput.run", "ts": merged.get("ts"),
+                        "goodput": run_doc.get("goodput"),
+                        "wall_s": run_doc.get("wall_s"),
+                        "n_ranks": run_doc.get("n_ranks"),
+                        "comm_source": run_doc.get("comm_source"),
+                        "biggest_thief": run_doc.get("biggest_thief"),
+                    })
                 write_jsonl(self.jsonl_path,
                             [{"kind": f"alert.{e['event']}", **e}
                              for e in alert_events]
+                            + goodput_records
                             + [{"kind": "gang_snapshot", **merged,
                                 "heartbeats": self._merged_heartbeats()}],
                             append=True)
@@ -560,6 +577,40 @@ class FleetCollector:
         """The last stitched whole-request trees (newest first)."""
         with self._lock:
             return list((self._rpc_doc or {}).get("traces") or [])
+
+    def _merge_goodput(self) -> None:
+        """Fold every scraped rank's ``goodput`` ledger section (plus
+        this collector's own bus's, when a driver-side ledger shares
+        it) into ONE run-level report, published as the
+        ``goodput_run`` section — so the JSONL sink, ``/telemetry``,
+        ``/gang``, postmortem bundles, and ``timeline --goodput`` all
+        carry the same run accounting. The last-good contract applies:
+        a dead rank's final ledger keeps contributing."""
+        from sparktorch_tpu.obs import goodput as _goodput
+
+        with self._lock:
+            snaps = {r: st.snapshot for r, st in self._ranks.items()}
+        docs = _goodput.sections_from_snapshots(snaps)
+        own = self.telemetry.get_section(_goodput.SECTION)
+        if isinstance(own, Mapping):
+            docs.setdefault("collector", own)
+        if not docs:
+            return
+        run = _goodput.merge_sections(docs)
+        run["run_id"] = self.run_id
+        self.telemetry.set_section(_goodput.RUN_SECTION, run)
+
+    def goodput_view(self) -> Optional[Dict[str, Any]]:
+        """The run-level goodput report ``GET /goodput`` serves —
+        recomputed from the freshest last-good snapshots at read time
+        (a rank's ledger advances between poll sweeps only via
+        scrapes, so this is one merge over already-held state, never
+        a network hop). None when no rank has published a ledger."""
+        self._merge_goodput()
+        from sparktorch_tpu.obs import goodput as _goodput
+
+        doc = self.telemetry.get_section(_goodput.RUN_SECTION)
+        return dict(doc) if isinstance(doc, Mapping) else None
 
     # -- merged views ------------------------------------------------------
 
@@ -973,6 +1024,17 @@ class FleetCollector:
                         code, doc = collector._handle_history(params)
                         self._send(code, json.dumps(doc).encode(),
                                    content_type="application/json")
+                    elif route == "/goodput":
+                        doc = collector.goodput_view()
+                        if doc is None:
+                            self._send(404, json.dumps(
+                                {"ok": False,
+                                 "error": "no goodput ledger published "
+                                          "by any scraped rank"}).encode(),
+                                content_type="application/json")
+                        else:
+                            self._send(200, json.dumps(doc).encode(),
+                                       content_type="application/json")
                     elif route == "/gang":
                         self._send(200,
                                    json.dumps(collector.gang_view()).encode(),

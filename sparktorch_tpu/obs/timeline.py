@@ -299,6 +299,134 @@ def _rpc_from_jsonl(records: List[Dict[str, Any]]
 
 
 # ---------------------------------------------------------------------------
+# Goodput ledger rendering (stacked run-attribution bars)
+# ---------------------------------------------------------------------------
+
+# One glyph per bucket, in render order (compute first so the
+# productive share reads left-to-right as "the good part").
+_GOODPUT_GLYPHS = (
+    ("compute", "#"),
+    ("exposed_comm", "~"),
+    ("compile", "C"),
+    ("checkpoint", "K"),
+    ("data_wait", "D"),
+    ("restart_downtime", "R"),
+    ("resize_downtime", "Z"),
+    ("idle", "."),
+)
+
+
+def _goodput_bar(buckets: Dict[str, Any], wall_s: float,
+                 width: int = _BAR_W) -> str:
+    """Stacked attribution strip: each bucket sized by its share of
+    the wall. Rounding spill trims the largest segment (the same
+    discipline as the xprof budget bar)."""
+    if wall_s <= 0:
+        return "." * width
+    cells = [(glyph, int(round(width * min(
+        float(buckets.get(b, 0.0)) / wall_s, 1.0))))
+        for b, glyph in _GOODPUT_GLYPHS]
+    used = sum(n for _, n in cells)
+    while used > width:
+        glyph, n = max(cells, key=lambda c: c[1])
+        cells[cells.index((glyph, n))] = (glyph, n - 1)
+        used -= 1
+    return "".join(glyph * n for glyph, n in cells) + "." * (width - used)
+
+
+def render_goodput_report(doc: Dict[str, Any]) -> str:
+    """One terminal page from a run-level goodput report (the
+    collector's ``GET /goodput`` document, or a single rank's
+    ``goodput`` section): the run summary with the goodput fraction
+    and the biggest thief named, one stacked attribution bar per
+    rank, then the bucket table. The ``comm_source`` label says
+    whether exposed comm was measured (xprof) or modeled."""
+    per_rank = doc.get("per_rank")
+    if not isinstance(per_rank, dict) or not per_rank:
+        # A bare rank section renders as a one-rank run.
+        per_rank = {str(doc.get("rank", "?")): doc}
+    wall = float(doc.get("wall_s") or 0.0)
+    goodput = float(doc.get("goodput") or 0.0)
+    lines = [
+        f"goodput: {100 * goodput:.1f}% of {wall:.2f}s rank-seconds "
+        f"productive ({doc.get('n_ranks', len(per_rank))} ranks, "
+        f"{doc.get('n_steps', 0)} steps, {doc.get('compiles', 0)} "
+        f"compiles)"
+        + (f"   run: {doc['run_id']}" if doc.get("run_id") else ""),
+        f"exposed comm: {doc.get('comm_source', 'none')}"
+        + (f"   mfu: {100 * float(doc['mfu']):.2f}%"
+           if doc.get("mfu") is not None else ""),
+    ]
+    thief = doc.get("biggest_thief")
+    if not thief:
+        from sparktorch_tpu.obs.goodput import biggest_thief as _bt
+
+        ranked = _bt(doc)
+        if ranked:
+            thief = {"bucket": ranked[0], "seconds": ranked[1],
+                     "fraction": ranked[1] / max(wall, 1e-9)}
+    if thief:
+        lines.append(
+            f"biggest thief: {thief['bucket']} "
+            f"{float(thief['seconds']):.2f}s "
+            f"({100 * float(thief.get('fraction') or 0):.1f}% of wall)")
+    over = float(doc.get("overattributed_s") or 0.0)
+    if over > 0:
+        lines.append(f"WARNING: {over:.3f}s over-attributed "
+                     f"(double-counted regions)")
+    legend = " ".join(f"{g}={b}" for b, g in _GOODPUT_GLYPHS)
+    lines += ["", f"{'rank':>10} {'wall':>9} {'goodput':>8}  [{legend}]"]
+
+    def _rank_key(item):
+        try:
+            return (0, int(item[0]))
+        except (TypeError, ValueError):
+            return (1, str(item[0]))
+
+    for rank, rdoc in sorted(per_rank.items(), key=_rank_key):
+        rwall = float(rdoc.get("wall_s") or 0.0)
+        bar = _goodput_bar(rdoc.get("buckets") or {}, rwall)
+        lines.append(
+            f"{str(rank):>10} {rwall:>8.2f}s"
+            f" {100 * float(rdoc.get('goodput') or 0.0):>7.1f}%"
+            f"  {bar}"
+            + (f"  [{rdoc.get('comm_source')}]"
+               if rdoc.get("comm_source") not in (None, "none",
+                                                  doc.get("comm_source"))
+               else ""))
+    buckets = doc.get("buckets") or {}
+    fractions = doc.get("fractions") or {}
+    lines += ["", "buckets (rank-seconds summed):"]
+    for b, _ in _GOODPUT_GLYPHS:
+        sec = float(buckets.get(b, 0.0))
+        if sec <= 0:
+            continue
+        lines.append(f"  {b:<18} {sec:>9.3f}s"
+                     f"  {100 * float(fractions.get(b, 0.0)):>5.1f}%"
+                     + (f"  x{doc.get('counts', {}).get(b)}"
+                        if (doc.get("counts") or {}).get(b) else ""))
+    return "\n".join(lines) + "\n"
+
+
+def _goodput_from_jsonl(records: List[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """The newest goodput accounting in a JSONL file: a collector
+    sink/dump record carrying the merged ``goodput_run`` section wins;
+    a bare rank dump's ``goodput`` section renders as one lane."""
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        doc = sections.get("goodput_run")
+        if isinstance(doc, dict) and doc.get("buckets"):
+            return doc
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        doc = sections.get("goodput")
+        if isinstance(doc, dict) and doc.get("buckets"):
+            return doc
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Postmortem rendering (flight-recorder bundles)
 # ---------------------------------------------------------------------------
 
@@ -368,6 +496,18 @@ def render_postmortem_report(doc: Dict[str, Any], top: int = 40) -> str:
         lines.append("metric deltas over the last good window:")
         for name, delta in list(deltas.items())[:12]:
             lines.append(f"  {name:<56} +{delta:g}")
+    gp = doc.get("goodput")
+    if isinstance(gp, dict) and gp.get("buckets"):
+        from sparktorch_tpu.obs.goodput import biggest_thief as _bt
+
+        thief = _bt(gp)
+        lines.append("")
+        lines.append(
+            f"goodput at death: {100 * float(gp.get('goodput') or 0):.1f}%"
+            f" of {float(gp.get('wall_s') or 0):.2f}s rank-seconds"
+            + (f", biggest thief {thief[0]} {thief[1]:.2f}s"
+               if thief else "")
+            + f" (comm: {gp.get('comm_source', 'none')})")
     traces = doc.get("rpc_traces") or []
     if traces:
         lines.append("")
@@ -438,7 +578,8 @@ class FollowReader:
 
 # Record kinds --follow renders (everything else is metric volume the
 # tail mode exists to cut through). "span" is deliberately absent.
-_FOLLOW_PREFIXES = ("alert.", "ctl.", "ft_", "chaos", "gang_snapshot")
+_FOLLOW_PREFIXES = ("alert.", "ctl.", "ft_", "chaos", "gang_snapshot",
+                    "goodput")
 
 
 def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
@@ -462,6 +603,27 @@ def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
                 f"  value={rec.get('value')}"
                 f"  threshold={rec.get('threshold')}"
                 f"  episode={rec.get('episode')}")
+    if kind.startswith("goodput"):
+        # The ledger's condensed record (goodput.ledger events, or a
+        # sink-dumped run doc): one line says how productive the run
+        # is NOW and who is stealing the rest.
+        who = (f" rank={rec['rank']}"
+               if rec.get("rank") is not None else "")
+        frac = rec.get("goodput")
+        thief = rec.get("thief")
+        if thief is None:
+            bt = rec.get("biggest_thief") or {}
+            thief, thief_s = bt.get("bucket"), bt.get("seconds")
+        else:
+            thief_s = rec.get("thief_s")
+        return (f"{stamp}  {kind:<18} {who}"
+                + (f" goodput={100 * float(frac):.1f}%"
+                   if frac is not None else "")
+                + f" wall={float(rec.get('wall_s') or 0.0):.2f}s"
+                + (f" thief={thief}:{float(thief_s or 0.0):.2f}s"
+                   if thief else "")
+                + (f" comm={rec['comm_source']}"
+                   if rec.get("comm_source") else ""))
     who = ""
     if rec.get("rank") is not None:
         who = f" rank={rec['rank']}"
@@ -692,8 +854,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "stitched traces")
     parser.add_argument("--follow", action="store_true",
                         help="tail a growing JSONL sink live: render "
-                             "alert firings and control-plane "
-                             "transitions as they land (Ctrl-C stops)")
+                             "alert firings, control-plane transitions "
+                             "and goodput ledger records as they land "
+                             "(Ctrl-C stops)")
+    parser.add_argument("--goodput", action="store_true",
+                        help="render a run-level goodput ledger "
+                             "(a saved GET /goodput document, or a "
+                             "collector/telemetry .jsonl carrying the "
+                             "goodput_run/goodput section): stacked "
+                             "attribution bar per rank, biggest thief "
+                             "named")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis dict as JSON")
     parser.add_argument("--top", type=int, default=None,
@@ -709,10 +879,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.top = 40 if args.postmortem else 10
 
     if sum((args.gang, args.tune, args.rpc, args.postmortem,
-            args.follow)) > 1:
-        print("error: --gang, --tune, --rpc, --postmortem and --follow "
-              "are different reports; pick one")
+            args.follow, args.goodput)) > 1:
+        print("error: --gang, --tune, --rpc, --postmortem, --follow "
+              "and --goodput are different reports; pick one")
         return 2
+    if args.goodput:
+        return _main_goodput(args)
     if args.tune:
         return _main_tune(args)
     if args.rpc:
@@ -788,6 +960,43 @@ def _main_tune(args) -> int:
                   f"(kind != 'tune')")
             return 1
     print(json.dumps(doc) if args.json else render_tune_report(doc),
+          end="" if not args.json else "\n")
+    return 0
+
+
+def _main_goodput(args) -> int:
+    """--goodput: a saved /goodput JSON document, or a JSONL whose
+    newest record carries the goodput_run (collector) / goodput
+    (single rank) section."""
+    if len(args.paths) > 1:
+        print("error: --goodput renders one file at a time")
+        return 2
+    path = args.paths[0]
+    if _looks_like_jsonl(path):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(path)
+        except OSError as e:
+            print(f"error: {e}")
+            return 1
+        doc = _goodput_from_jsonl(records)
+        if doc is None:
+            print(f"no goodput ledger (sections.goodput_run / "
+                  f"sections.goodput) in {path}")
+            return 1
+    else:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}")
+            return 1
+        if not isinstance(doc, dict) or not doc.get("buckets"):
+            print(f"error: {path} is not a goodput document "
+                  f"(no buckets)")
+            return 1
+    print(json.dumps(doc) if args.json else render_goodput_report(doc),
           end="" if not args.json else "\n")
     return 0
 

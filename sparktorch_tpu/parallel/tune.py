@@ -545,6 +545,16 @@ class TuneResult:
     alpha_source: str = "default"  # arg | env | probe | default
     cache_hit: bool = False      # loaded from the tune-result cache
     cache_key: Optional[str] = None  # (workload, rig) fingerprint hash
+    # The search's total compile bill — every candidate the tuner
+    # compiled (count + summed walls). The mesh='auto' step builder
+    # ADDS its own fresh-closure recompile of the winner here the
+    # moment the goodput cache-miss probe sees it, so "the auto path
+    # compiles its winner twice" is a visible number on the live
+    # result, not a README caveat. (The artifact/cache entry carries
+    # the search-time bill; a cache HIT run's only compile is the
+    # winner's own.)
+    compile_count: int = 0
+    compile_s_total: float = 0.0
 
     def best_config(self) -> MeshConfig:
         sizes = {a: int(self.best.get(a, 1)) for a in ALL_AXES}
@@ -591,6 +601,8 @@ class TuneResult:
             "alpha_source": self.alpha_source,
             "cache_hit": self.cache_hit,
             "cache_key": self.cache_key,
+            "compile_count": self.compile_count,
+            "compile_s_total": round(self.compile_s_total, 6),
             "caps": {k: list(v) for k, v in self.caps.items()},
             "n_candidates": len(self.candidates),
             "n_measured": sum(c.status == STATUS_MEASURED
@@ -629,6 +641,8 @@ class TuneResult:
             alpha_source=str(d.get("alpha_source", "default")),
             cache_hit=bool(d.get("cache_hit", False)),
             cache_key=d.get("cache_key"),
+            compile_count=int(d.get("compile_count", 0)),
+            compile_s_total=float(d.get("compile_s_total", 0.0)),
         )
 
     def save(self, path: str) -> str:
@@ -1109,6 +1123,14 @@ def autotune(
         if cached is not None:
             cached.cache_hit = True
             cached.cache_key = cache_key
+            # The compile bill is per-RUN, not per-search: a cache hit
+            # compiled nothing here, and the live result (and the
+            # artifact a hit run writes) must report what THIS process
+            # paid — zero so far; the mesh='auto' builder adds the
+            # winner's own compile when it happens. The cache ENTRY on
+            # disk keeps the original search-time bill.
+            cached.compile_count = 0
+            cached.compile_s_total = 0.0
             cached.publish(telemetry)
             if artifact_path:
                 cached.save(artifact_path)
@@ -1170,8 +1192,13 @@ def autotune(
     prepare = measure_fn or prepare_candidate
     # Phase A: compile every survivor (outside any capture). A layout
     # the partitioner rejects becomes a failed candidate, never a
-    # failed search.
+    # failed search. Each successful prepare is one XLA compile —
+    # counted + summed into the result's compile bill (and into the
+    # ambient goodput ledger's compile bucket when a run installed
+    # one: the search's compile wall is part of the run's wall).
     runners: List[Tuple[Candidate, Callable]] = []
+    compile_count = 0
+    compile_s_total = 0.0
     for cand in to_measure:
         try:
             runner = prepare(
@@ -1184,6 +1211,12 @@ def autotune(
             _LOG.warning(f"[sparktorch_tpu:tune] candidate {cand.label} "
                          f"failed to prepare: {cand.reason}")
             continue
+        compile_count += 1
+        cand_compile_s = float(getattr(runner, "compile_s", 0.0))
+        compile_s_total += cand_compile_s
+        from sparktorch_tpu.obs import goodput as _goodput
+
+        _goodput.note_compile(cand_compile_s, site="tune")
         runners.append((cand, runner))
 
     # Phase B: interleaved measurement rounds. Every live candidate
@@ -1280,6 +1313,8 @@ def autotune(
         alpha_bytes=float(alpha_bytes),
         alpha_source=alpha_source,
         cache_key=cache_key,
+        compile_count=compile_count,
+        compile_s_total=compile_s_total,
     )
     result.publish(telemetry)
     if artifact_path:

@@ -45,6 +45,7 @@ import numpy as np
 
 from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.net import wire as binwire
+from sparktorch_tpu.obs import goodput as _goodput
 from sparktorch_tpu.obs import (
     PROMETHEUS_CONTENT_TYPE,
     Telemetry,
@@ -197,7 +198,12 @@ class ParameterServer:
                 # the only honest way to span it.
                 tracer.record("queue_wait", tctx, enq_ts, t0 - enq_t0,
                               kind="server")
-                with tracer.child_span("apply", tctx, kind="server"):
+                # A serving rank's productive seconds are its applies:
+                # the same writer stamp the rpc trace spans, attributed
+                # into the ambient goodput ledger's compute bucket
+                # (no-op when no ledger is installed on this rank).
+                with tracer.child_span("apply", tctx, kind="server"), \
+                        _goodput.span("compute", {"site": "ps_apply"}):  # lint-obs: ok (wrapped with-block continuation)
                     version, params = self.slot.read()
                     grads = jax.device_put(grads, self.device)
                     new_params, new_opt = self._apply_fn(

@@ -67,6 +67,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparktorch_tpu.models.transformer import EncoderLayer, TransformerConfig
+from sparktorch_tpu.obs import goodput as _goodput
 from sparktorch_tpu.parallel.compat import axis_size as _axis_size
 from sparktorch_tpu.ops.attention import dense_attention
 from sparktorch_tpu.parallel.mesh import (
@@ -2297,6 +2298,11 @@ def make_pp_train_step(
 
     step.eval_loss = eval_loss
     step.memory_analysis = memory_analysis
+    # Goodput compile detection: the trainer probes the lazily-built
+    # jitted's dispatch-cache size around each call (None until the
+    # first _ensure_built, which reads as "no signal").
+    step.jit_cache_size = (
+        lambda: _goodput.jit_cache_size(cache.get("jitted")))
     return step
 
 
@@ -2420,8 +2426,6 @@ def train_distributed_pipeline(
     unstacked back — the returned ``TrainResult`` bundles ordinary
     ``CausalLM`` params that transform through the module apply.
     """
-    import time
-
     from sparktorch_tpu.models.transformer import CausalLM, SequenceClassifier
     from sparktorch_tpu.obs import get_logger, get_telemetry
     from sparktorch_tpu.parallel.launch import check_gang, notify_gang_step
@@ -2704,35 +2708,48 @@ def train_distributed_pipeline(
                 # heartbeat so the driver can read cross-rank skew.
                 check_gang()
                 notify_gang_step(i)
-                t0 = time.perf_counter()
                 sample_key, sub = jax.random.split(sample_key)
-                with tele.span("train_pp/step_call"), \
-                        step_annotation(i, telemetry=tele):
-                    state, out = step(state, batch, key=sub)
-                if steps_per_call == 1:
-                    losses = [float(out)]
-                    gnorms = [step.last_grad_norm]
-                    exs = [step.last_examples]
-                    drops = [step.last_drop_fraction]
-                else:
-                    losses = [float(v) for v in np.asarray(out.loss)]
-                    gnorms = [float(v) for v in np.asarray(out.grad_norm)]
-                    exs = [float(v) for v in np.asarray(out.examples)]
-                    drops = (
-                        [float(v) for v in np.asarray(out.drop_fraction)]
-                        if out.drop_fraction is not None
-                        else [None] * steps_per_call
-                    )
+                # Goodput step clock: dispatch + loss materialization
+                # timed by a LedgerSpan (step_time_s comes off its
+                # duration; the seconds land in the ledger's step
+                # bucket when one is armed, re-aimed at ``compile``
+                # when the jitted's dispatch cache grew under it).
+                cache0 = (step.jit_cache_size()
+                          if _goodput.active() is not None else None)
+                with _goodput.step_span() as _led:
+                    with tele.span("train_pp/step_call"), \
+                            step_annotation(i, telemetry=tele):
+                        state, out = step(state, batch, key=sub)
+                    if steps_per_call == 1:
+                        losses = [float(out)]
+                        gnorms = [step.last_grad_norm]
+                        exs = [step.last_examples]
+                        drops = [step.last_drop_fraction]
+                    else:
+                        losses = [float(v) for v in np.asarray(out.loss)]
+                        gnorms = [float(v) for v in np.asarray(out.grad_norm)]
+                        exs = [float(v) for v in np.asarray(out.examples)]
+                        drops = (
+                            [float(v) for v in np.asarray(out.drop_fraction)]
+                            if out.drop_fraction is not None
+                            else [None] * steps_per_call
+                        )
+                    _led.count = len(losses)
+                    if cache0 is not None and (
+                            step.jit_cache_size() or cache0) > cache0:
+                        _led.rebucket("compile")
                 # Time the once-per-call eval separately: smearing it
                 # into the per-step dt would inflate step_time_s by
-                # eval_wall/steps_per_call (ADVICE r04).
-                t_eval0 = time.perf_counter()
-                val_loss = (
-                    float(step.eval_loss(state, val_batch))
-                    if val_batch is not None else None
-                )
-                eval_s = time.perf_counter() - t_eval0
-                dt = (time.perf_counter() - t0 - eval_s) / len(losses)
+                # eval_wall/steps_per_call (ADVICE r04). Productive
+                # device work, so the ledger files it under compute.
+                with _goodput.span("compute", {"site": "pp_eval"}) \
+                        as _eval_led:
+                    val_loss = (
+                        float(step.eval_loss(state, val_batch))
+                        if val_batch is not None else None
+                    )
+                eval_s = _eval_led.duration_s
+                dt = _led.duration_s / len(losses)
                 for j, (l, g, e, dr) in enumerate(
                     zip(losses, gnorms, exs, drops)
                 ):

@@ -15,7 +15,6 @@ weight-zero padding (see utils/data.py).
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
@@ -25,6 +24,7 @@ from jax.sharding import Mesh
 
 from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.obs import get_logger, get_telemetry
+from sparktorch_tpu.obs import goodput as _goodput
 from sparktorch_tpu.parallel.launch import check_gang, notify_gang_step
 from sparktorch_tpu.parallel.mesh import BATCH_AXES, batch_sharding, build_mesh, replicated
 from sparktorch_tpu.train.step import (
@@ -234,7 +234,9 @@ def train_distributed(
         if spec.input_shape is None:
             spec.input_shape = tuple(train_batch.x.shape[1:])
     else:
-        with tele.span("train/data_prep"):
+        # data_wait: host-side batch prep + host->device placement is
+        # time the accelerators spend waiting on input.
+        with tele.span("train/data_prep"), _goodput.span("data_wait"):
             train_batch, val_batch = _as_batch(data, labels, validation_pct,
                                                seed)
             if spec.input_shape is None:
@@ -258,7 +260,10 @@ def train_distributed(
     # (non-fully-addressable) meshes where a host-side device_put of
     # replicated state cannot (the reference replicates the model onto
     # every executor, distributed.py:112-115).
-    with tele.span("train/init"), mesh:
+    # The jitted init is a compile-dominated call (one trace+compile,
+    # negligible device work) — the ledger's compile bucket takes it.
+    with tele.span("train/init"), _goodput.span(
+            "compile", {"site": "train_init"}), mesh:
         state = jax.jit(
             lambda: create_train_state(spec, rng, sample_x=sample_x, tx=tx),
             out_shardings=replicated(mesh),
@@ -365,36 +370,52 @@ def train_distributed(
                 # irrelevant across resumes.
                 _chaos.fire("worker.step", worker=jax.process_index(),
                             step=i)
-                t0 = time.perf_counter()
+                # The step clock is a goodput LedgerSpan: it times the
+                # dispatch+sync region whether or not a ledger is
+                # active (step_time_s comes off its duration), and when
+                # one is, the seconds land in the step bucket — or in
+                # ``compile`` when the jit dispatch cache grew under
+                # the call (first call / new shape).
+                cache0 = (_goodput.jit_cache_size(train_step)
+                          if _goodput.active() is not None else None)
                 if steps_per_call > 1:
                     n = min(steps_per_call, iters - i)
-                    with tele.span("train/step_chunk") as _chunk_span, \
-                            step_annotation(
-                                int(metrics[-1]["iter"]) + 1 if metrics else 0,
-                                telemetry=tele):
+                    with _goodput.step_span() as _led:
+                        with tele.span("train/step_chunk") as _chunk_span, \
+                                step_annotation(
+                                    int(metrics[-1]["iter"]) + 1
+                                    if metrics else 0,
+                                    telemetry=tele):
+                            if fused_signals:
+                                args = (((state, es_state), train_batch,
+                                         val_batch)
+                                        if val_batch is not None
+                                        else ((state, es_state), train_batch))
+                                (state, es_state), stacked = train_step(*args)
+                            else:
+                                state, stacked = train_step(state, train_batch)
+                            _chunk_span.sync(stacked.loss)
+                        losses = np.asarray(stacked.loss)[:n]
+                        examples = np.asarray(stacked.examples)[:n]
+                        gnorms = np.asarray(stacked.grad_norm)[:n]
                         if fused_signals:
-                            args = (((state, es_state), train_batch, val_batch)
-                                    if val_batch is not None
-                                    else ((state, es_state), train_batch))
-                            (state, es_state), stacked = train_step(*args)
+                            vals = np.asarray(stacked.val_loss)[:n]
+                            actives = np.asarray(stacked.active)[:n]
                         else:
-                            state, stacked = train_step(state, train_batch)
-                        _chunk_span.sync(stacked.loss)
-                    losses = np.asarray(stacked.loss)[:n]
-                    examples = np.asarray(stacked.examples)[:n]
-                    gnorms = np.asarray(stacked.grad_norm)[:n]
-                    if fused_signals:
-                        vals = np.asarray(stacked.val_loss)[:n]
-                        actives = np.asarray(stacked.active)[:n]
-                    else:
-                        vals = [None] * n
-                        actives = [True] * n
-                    drops = (
-                        np.asarray(stacked.drop_fraction)[:n]
-                        if stacked.drop_fraction is not None else [None] * n
-                    )
-                    n_active = int(np.sum(np.asarray(actives)))
-                    dt = (time.perf_counter() - t0) / max(1, n_active)
+                            vals = [None] * n
+                            actives = [True] * n
+                        drops = (
+                            np.asarray(stacked.drop_fraction)[:n]
+                            if stacked.drop_fraction is not None
+                            else [None] * n
+                        )
+                        n_active = int(np.sum(np.asarray(actives)))
+                        _led.count = max(1, n_active)
+                        if cache0 is not None and (
+                                _goodput.jit_cache_size(train_step)
+                                or cache0) > cache0:
+                            _led.rebucket("compile")
+                    dt = _led.duration_s / max(1, n_active)
                     chunk = [
                         (float(l), float(e), float(g),
                          None if v is None or np.isnan(v) else float(v),
@@ -403,21 +424,33 @@ def train_distributed(
                                                      vals, actives, drops)
                     ]
                 else:
-                    with tele.span("train/step") as _step_span, \
-                            step_annotation(i, telemetry=tele):
-                        state, step_metrics = train_step(state, train_batch)
-                        _step_span.sync(step_metrics.loss)
+                    with _goodput.step_span() as _led:
+                        with tele.span("train/step") as _step_span, \
+                                step_annotation(i, telemetry=tele):
+                            state, step_metrics = train_step(state,
+                                                             train_batch)
+                            _step_span.sync(step_metrics.loss)
+                        if cache0 is not None and (
+                                _goodput.jit_cache_size(train_step)
+                                or cache0) > cache0:
+                            _led.rebucket("compile")
+                    if eval_step is not None:
+                        # The per-iteration val forward is productive
+                        # device work, just not a train step.
+                        with _goodput.span("compute", {"site": "eval"}):
+                            val_now = float(eval_step(state, val_batch))
+                    else:
+                        val_now = None
                     chunk = [(
                         float(step_metrics.loss),
                         float(step_metrics.examples),
                         float(step_metrics.grad_norm),
-                        float(eval_step(state, val_batch))
-                        if eval_step is not None else None,
+                        val_now,
                         True,
                         float(step_metrics.drop_fraction)
                         if step_metrics.drop_fraction is not None else None,
                     )]
-                    dt = time.perf_counter() - t0
+                    dt = _led.duration_s
 
                 for loss, examples_n, gnorm, val_loss, active, drop_f in chunk:
                     if not active:
@@ -709,7 +742,8 @@ def train_distributed_streaming(
     tx = spec.make_optimizer()
     rng = jax.random.key(seed)
     sample_x = jnp.zeros((1,) + tuple(x.shape[1:]), jnp.float32)
-    with mesh:
+    # Compile-dominated (same attribution as the DP trainer's init).
+    with _goodput.span("compile", {"site": "train_init"}), mesh:
         state = jax.jit(
             lambda: create_train_state(spec, rng, sample_x=sample_x, tx=tx),
             out_shardings=replicated(mesh),
@@ -760,24 +794,40 @@ def train_distributed_streaming(
             check_gang()
             order = shuffle_rng.permutation(n)
             starts = list(range(0, n, chunk_rows))
-            resident = put_chunk(starts[0], order)
+            # The epoch's first chunk has nothing to hide under: a
+            # pure data wait.
+            with _goodput.span("data_wait", {"site": "streaming_chunk"}):
+                resident = put_chunk(starts[0], order)
             for ci, lo in enumerate(starts):
                 # Per-chunk liveness, matching train_distributed: a
                 # peer host dying mid-epoch must abort before the next
                 # compiled dispatch, not at the epoch boundary.
                 check_gang()
                 notify_gang_step(it_counter)
-                t0 = time.perf_counter()
-                with tele.span("train_streaming/chunk"):
+                cache0 = (_goodput.jit_cache_size(step_fn)
+                          if _goodput.active() is not None else None)
+                with _goodput.step_span() as _led, \
+                        tele.span("train_streaming/chunk"):  # lint-obs: ok (wrapped with-block continuation)
                     state, metrics = step_fn(state, resident)
                     # Enqueue the NEXT chunk's host->device copy while
                     # the current chunk's (already dispatched) steps
-                    # compute.
+                    # compute. The placement is a nested data_wait
+                    # span: its seconds subtract from this chunk's
+                    # step attribution (one second, one bucket) —
+                    # though being deliberately overlapped under the
+                    # in-flight compute, it is usually small.
                     if ci + 1 < len(starts):
-                        resident = put_chunk(starts[ci + 1], order)
+                        with _goodput.span("data_wait",
+                                           {"site": "streaming_chunk"}):
+                            resident = put_chunk(starts[ci + 1], order)
                     losses = np.asarray(metrics.loss).reshape(-1)
+                    _led.count = len(losses)
+                    if cache0 is not None and (
+                            _goodput.jit_cache_size(step_fn)
+                            or cache0) > cache0:
+                        _led.rebucket("compile")
                 examples = np.asarray(metrics.examples).reshape(-1)
-                dt = (time.perf_counter() - t0) / len(losses)
+                dt = _led.duration_s / len(losses)
                 for j in range(len(losses)):
                     record = {
                         "round": epoch, "iter": it_counter,

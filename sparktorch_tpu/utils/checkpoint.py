@@ -21,6 +21,8 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from sparktorch_tpu.obs import goodput as _goodput
+
 
 def _disarm_persistent_cache_after_restore() -> None:
     """Work around a jax-0.4.x CPU crash: executing a persistent-
@@ -134,11 +136,16 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        saved = self._mgr.save(
-            step,
-            args=ocp.args.StandardSave(_encode_keys(state._asdict())),
-            force=force,
-        )
+        # The save wall lands in the goodput ledger's ``checkpoint``
+        # bucket (ambient: a run without a ledger pays two
+        # perf_counter reads). Nested under a step-chunk span it
+        # subtracts cleanly — one second of wall, one bucket.
+        with _goodput.span("checkpoint", {"op": "save"}):
+            saved = self._mgr.save(
+                step,
+                args=ocp.args.StandardSave(_encode_keys(state._asdict())),
+                force=force,
+            )
         return bool(saved)
 
     def latest_step(self) -> Optional[int]:
@@ -158,10 +165,12 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self._dir}")
         abstract = abstract_state._asdict()
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.StandardRestore(_encode_abstract_keys(abstract)),
-        )
+        with _goodput.span("checkpoint", {"op": "restore"}):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.StandardRestore(
+                    _encode_abstract_keys(abstract)),
+            )
         _disarm_persistent_cache_after_restore()
         return type(abstract_state)(**_decode_keys(restored, abstract))
 
@@ -184,9 +193,10 @@ def save_model(directory: str, params: Any, model_state: Any = None) -> None:
     dill blob in a string column)."""
     path = os.path.abspath(directory)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "model"),
-               {"params": params, "model_state": model_state or {}})
-    ckptr.wait_until_finished()
+    with _goodput.span("checkpoint", {"op": "save_model"}):
+        ckptr.save(os.path.join(path, "model"),
+                   {"params": params, "model_state": model_state or {}})
+        ckptr.wait_until_finished()
 
 
 def load_model(directory: str, abstract: Optional[Any] = None):
@@ -195,6 +205,7 @@ def load_model(directory: str, abstract: Optional[Any] = None):
     target = None
     if abstract is not None:
         target = {"params": abstract, "model_state": {}}
-    out = ckptr.restore(os.path.join(path, "model"), target)
+    with _goodput.span("checkpoint", {"op": "load_model"}):
+        out = ckptr.restore(os.path.join(path, "model"), target)
     _disarm_persistent_cache_after_restore()
     return out["params"], out.get("model_state") or {}

@@ -97,11 +97,30 @@ def cross_entropy_auto(preds: jax.Array, targets: jax.Array) -> jax.Array:
     """``cross_entropy`` registry entry. LM-shaped integer-label logits
     (batch, seq, vocab) dispatch to the fused Pallas kernel — the
     workload it was built for (CausalLM training) — at trace time;
-    everything else takes the dense path."""
+    everything else takes the dense path.
+
+    GSPMD-aware fallback: under a GSPMD mesh on a non-TPU backend the
+    Pallas kernel runs in INTERPRET mode and lowers to a while loop
+    the partitioner can only handle by all-gathering the logits into
+    every shard — a spurious all-gather that pollutes collective
+    profiles and, now that the goodput ledger attributes exposed comm,
+    the ``exposed_comm`` bucket (ROADMAP item-1 follow-up; the
+    bench_moe_a2a docstring documents the same artifact). Real TPU
+    keeps the kernel: the compiled Pallas call partitions cleanly and
+    the streaming-CE memory win is the whole point there. Both trace-
+    time probes fail CLOSED (``ambient_gspmd_mesh`` returns None on
+    any API drift, and inside shard_map bodies — where the fused
+    kernel is the right choice — every mesh axis is Manual, so the
+    mesh probe reads None and the kernel stays)."""
     lm_shaped = preds.ndim == 3 and not (
         jnp.issubdtype(targets.dtype, jnp.floating) and targets.shape == preds.shape
     )
     if lm_shaped:
+        from sparktorch_tpu.parallel.compat import ambient_gspmd_mesh
+
+        if jax.default_backend() != "tpu" \
+                and ambient_gspmd_mesh() is not None:
+            return cross_entropy_loss(preds, targets)
         return fused_cross_entropy_loss(preds, targets)
     return cross_entropy_loss(preds, targets)
 

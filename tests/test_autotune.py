@@ -435,6 +435,57 @@ def test_tune_result_artifact_roundtrip(tmp_path):
         TuneResult.load(str(other))
 
 
+def test_tune_result_compile_bill_stamped(tmp_path):
+    """The search's compile bill is a visible number: one compile per
+    prepared candidate (the scripted runner declares compile_s=1.0
+    each), stamped into the TuneResult AND the artifact — and the
+    mesh='auto' builder's winner recompile lands on the same counters
+    (test_goodput pins the cache-miss detection; here the accounting
+    contract)."""
+    spec, batch = _fake_spec_and_batch()
+    devices = list(range(8))
+    walls = {label: (0.010 + 0.001 * i, 0.001) for i, label in enumerate([
+        "dp8", "fsdp8", "fsdp4xtp2", "dp2xfsdp4", "dp4xfsdp2",
+        "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"])}
+    path = str(tmp_path / "tune_result.json")
+    result = autotune(spec, batch, devices, steps=2, measure_top_k=3,
+                      measure_fn=_fake_measure(walls),
+                      alpha_bytes=1 << 20, artifact_path=path)
+    assert result.compile_count == 3  # one per prepared candidate
+    assert result.compile_s_total == pytest.approx(3.0)
+    doc = TuneResult.load(path).to_dict()
+    assert doc["compile_count"] == 3
+    assert doc["compile_s_total"] == pytest.approx(3.0)
+    # The winner's fresh-closure recompile is ADDED in place (what
+    # make_sharded_train_step does on a detected cache miss).
+    result.compile_count += 1
+    result.compile_s_total += 2.5
+    assert result.compile_count == 4
+    # A failed prepare never counts as a compile.
+    calls = []
+
+    def prepare(spec_, config, batch_, devices_, **kw):
+        from sparktorch_tpu.parallel.tune import mesh_label as _ml
+
+        calls.append(_ml(config.resolve(len(devices_))))
+        if len(calls) == 1:
+            raise RuntimeError("compile exploded")
+
+        def runner(steps):
+            return {"walls": [0.01] * steps, "comm_fraction": 0.1,
+                    "overlap_fraction": 0.0,
+                    "exposed_comm_fraction": 0.0,
+                    "n_collective_events": 0, "counts": {}}
+
+        runner.compile_s = 0.5
+        return runner
+
+    result2 = autotune(spec, batch, devices, steps=2, measure_top_k=2,
+                       measure_fn=prepare, alpha_bytes=1 << 20)
+    assert result2.compile_count == 1
+    assert result2.compile_s_total == pytest.approx(0.5)
+
+
 def test_tune_publish_puts_xprof_tune_on_the_bus(tmp_path):
     from sparktorch_tpu.obs import Telemetry
 
