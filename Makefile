@@ -6,9 +6,9 @@ PYTHON ?= python
 
 .PHONY: install test test-fast test-pyspark native bench bench-all \
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
-	bench-ps-fleet bench-tune bench-rpc-trace bench-serve \
-	bench-elastic bench-obs-history bench-moe bench-goodput \
-	cluster-up clean lint-obs
+	bench-ps-fleet bench-tune bench-pp-tune bench-rpc-trace \
+	bench-serve bench-elastic bench-obs-history bench-moe \
+	bench-goodput cluster-up clean lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -98,9 +98,10 @@ lint-obs:
 	fi; \
 	hits=$$(grep -rn --include='*.py' -E 'time\.perf_counter\(' \
 		sparktorch_tpu/train/ sparktorch_tpu/ctl/ \
+		sparktorch_tpu/parallel/ \
 		| grep -v 'lint-obs: ok'); \
 	if [ -n "$$hits" ]; then \
-		echo "lint-obs: raw perf_counter timing in train/ or ctl/"; \
+		echo "lint-obs: raw perf_counter timing in train/, ctl/, or parallel/"; \
 		echo "(measured regions go through obs.goodput LedgerSpans so"; \
 		echo "the run-level time ledger stays MECE — use"; \
 		echo "goodput.span/step_span and read .duration_s, or annotate"; \
@@ -210,6 +211,23 @@ bench-tune:
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
 	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
 	$(PYTHON) -m sparktorch_tpu.bench --config mesh_tune
+
+# Pipeline-schedule tuning + recompile-tax gate (ROADMAP item 4):
+# (a) the tuner searches dp x pp x {gpipe,1f1b,interleaved} x
+# virtual_stages, measured through the PIPELINE trainer, and must
+# choose within tolerance (default 15%) of the exhaustively-measured
+# winner; (b) a cache-warm mesh="auto" build must compile LESS than
+# the cold path (TuneResult.compile_count drops, the goodput ledger's
+# `compile` bucket shows the seconds saved, the warm tune wall
+# collapses to a cache hit) — FAILS otherwise. The record is retained
+# (--log) so the tuner-wall drift gate arms against the windowed
+# median of prior rounds (SPARKTORCH_TPU_PP_TUNE_DRIFT_TOL, relative,
+# default 1.0 + 5s floor). Defaults to the 8-virtual-device CPU rig.
+bench-pp-tune:
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} \
+	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8 --xla_cpu_enable_concurrency_optimized_scheduler=false}" \
+	$(PYTHON) -m sparktorch_tpu.bench --config pp_tune \
+		--log benchmarks/bench_r12_pptune.jsonl
 
 # Gang-observability gate: spin local rank exporters, run the fleet
 # collector, and FAIL unless the merged scrape reconciles with the

@@ -2061,10 +2061,14 @@ def bench_sharded_trace() -> dict:
                     f"use 'tp2' or 'auto'"
                 )
             if mesh_knob == "auto":
-                from sparktorch_tpu.parallel.tune import autotune
+                from sparktorch_tpu.parallel.tune import GSPMD_AXES, autotune
 
+                # GSPMD_AXES: this leg builds a GSPMD step below — a
+                # pp>1 schedule winner would not fit it (the pp space
+                # has its own gate, bench-pp-tune).
                 tuned = autotune(spec, batch, devices, steps=3,
-                                 measure_top_k=3, telemetry=tele)
+                                 measure_top_k=3, telemetry=tele,
+                                 axes=GSPMD_AXES)
                 mesh = build_mesh(tuned.best_config(), devices)
             else:
                 mesh = build_mesh(MeshConfig(tp=2) if n_dev % 2 == 0
@@ -2234,6 +2238,10 @@ def bench_mesh_tune() -> dict:
     - the full ranking + prune log round-trips through the
       ``tune_result.json`` artifact.
 
+    Scope: the GSPMD mesh zoo (axes=GSPMD_AXES). The pp x schedule
+    dimension has its own referee with pipeline-trainer measurement —
+    ``make bench-pp-tune``.
+
     The record reports both rankings, the prune decisions, and the
     chosen budget."""
     import os
@@ -2243,7 +2251,7 @@ def bench_mesh_tune() -> dict:
 
     from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
     from sparktorch_tpu.obs import Telemetry
-    from sparktorch_tpu.parallel.tune import TuneResult, autotune
+    from sparktorch_tpu.parallel.tune import GSPMD_AXES, TuneResult, autotune
     from sparktorch_tpu.utils.data import DataBatch
     from sparktorch_tpu.utils.serde import ModelSpec
 
@@ -2280,7 +2288,7 @@ def bench_mesh_tune() -> dict:
             tuned = autotune(
                 spec, batch, devices, steps=steps, repeats=repeats,
                 measure_top_k=top_k, artifact_path=artifact,
-                telemetry=tele,
+                telemetry=tele, axes=GSPMD_AXES,
             )
             # Artifact round-trip: the ranking and prune log must
             # survive the JSON (what `mesh="auto"` consumers read).
@@ -2322,7 +2330,7 @@ def bench_mesh_tune() -> dict:
         gc.collect()
         exhaustive = autotune(
             spec, batch, devices, steps=steps, repeats=repeats,
-            exhaustive=True, telemetry=tele,
+            exhaustive=True, telemetry=tele, axes=GSPMD_AXES,
         )
         ex_ranked = exhaustive.ranking()
         ex_by_label = {c.label: c for c in ex_ranked}
@@ -4410,6 +4418,281 @@ def bench_moe_a2a() -> dict:
             jax.config.update("jax_compilation_cache_dir", old_cache)
 
 
+def bench_pp_tune() -> dict:
+    """Pipeline-schedule auto-tuning + recompile-tax gate
+    (``make bench-pp-tune``, ROADMAP item 4). Two legs:
+
+    **Referee leg** — the tuner searches the dp x pp x schedule x
+    virtual_stages space (``axes=('dp','pp')``: the leg's subject is
+    the SCHEDULE dimension, not the whole mesh zoo bench-tune already
+    referees) on a 4-layer transformer, then an EXHAUSTIVE pass
+    measures every candidate; FAILS unless the chosen config sits
+    within ``SPARKTORCH_TPU_PP_TUNE_TOL`` (default 15%) of the
+    exhaustive winner's step wall, the space actually contained
+    measured pp>1 schedule candidates, and pruned candidates were
+    never executed.
+
+    **Recompile-tax leg** — a cold ``mesh="auto"`` build (fresh
+    tune-result cache) vs a warm one, each inside its own goodput
+    ledger; FAILS unless the warm build's ``TuneResult.compile_count``
+    drops below the cold path's, the warm tune wall collapses (cache
+    hit), and the warm ledger's ``compile`` bucket shows the saving
+    in seconds. This is the acceptance gate for "the auto path stops
+    compiling its winner twice": the persistent XLA cache (armed for
+    the whole bench process) makes the winner's fresh-closure
+    recompile a disk hit, and the tune-result cache deletes the
+    search.
+
+    The record retains both rankings + the compile bills; drift gate
+    vs the ``_prior_window`` median of ``tuner_wall_s`` is ARMED
+    (SPARKTORCH_TPU_PP_TUNE_DRIFT_TOL, relative, default 1.0 with a
+    5s floor) once a prior round is retained."""
+    import os
+    import tempfile
+
+    import jax
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.obs import Telemetry
+    from sparktorch_tpu.obs import goodput as goodput_mod
+    from sparktorch_tpu.parallel.tune import autotune, transformer_caps
+    from sparktorch_tpu.train.pipeline import PipelineState
+    from sparktorch_tpu.train.sharded import (
+        make_sharded_train_step,
+        shard_batch,
+    )
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    t0 = time.perf_counter()
+    tele = Telemetry(run_id="bench_pp_tune")
+    devices = jax.devices()
+    n_dev = len(devices)
+    rng = np.random.default_rng(0)
+
+    # ---- referee leg: pp x schedule vs exhaustive ---------------------
+    bsz, seq = 8 * n_dev, 32
+    batch = DataBatch(
+        x=np.asarray(rng.integers(0, 256, (bsz, seq)).astype(np.int32)),
+        y=np.asarray(rng.integers(0, 2, (bsz,)).astype(np.int32)),
+        w=np.ones((bsz,), np.float32),
+    )
+    # 4 layers: pp in {1, 2, 4}, interleaved V=2 legal at pp=2. Sized
+    # so layout differences beat scheduler jitter (the bench-tune
+    # sizing lesson).
+    cfg = tiny_transformer(d_model=128, d_ff=512, n_layers=4,
+                           max_len=seq)
+    module = SequenceClassifier(cfg)
+    spec = ModelSpec(module=module, loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3})
+    # 2 profiled steps x (1 warmup + 2 scored) rounds per candidate:
+    # schedule steps on this rig run seconds each, and the referee
+    # only needs a stable ORDERING, not tight walls.
+    steps, repeats, top_k = 2, 2, 3
+    axes = ("dp", "pp")
+    # Cap pp at 2 (the caps knob, not the axes): pp=2 already carries
+    # every schedule kind (gpipe / 1f1b / interleaved V=2 on the
+    # 4-layer stack), and the exhaustive referee measures EVERY
+    # candidate — pp=4 schedule steps on the 8-virtual-device CPU rig
+    # run ~100x the dp wall and would blow the bench budget without
+    # adding a schedule dimension to referee.
+    caps = dict(transformer_caps(cfg, seq))
+    caps["pp"] = (2,)
+    caps["sp"] = (1,)
+
+    tuned = autotune(
+        spec, batch, devices, axes=axes, caps=caps, steps=steps,
+        repeats=repeats, measure_top_k=top_k, telemetry=tele,
+    )
+    tuner_wall_s = tuned.wall_s
+    pruned = tuned.pruned()
+    if any(c.measured for c in pruned):
+        raise AssertionError("a pruned candidate was executed")
+    pp_cands = [c for c in tuned.candidates if c.axes.get("pp", 1) > 1]
+    if not pp_cands:
+        raise AssertionError("search space contained no pp>1 candidate")
+    if not any(c.schedule for c in pp_cands):
+        raise AssertionError("pp candidates carry no schedule meta")
+    scheds = {c.schedule["schedule"] for c in pp_cands if c.schedule}
+    if not {"gpipe", "1f1b"} <= scheds:
+        raise AssertionError(
+            f"schedule dims missing from the space: {sorted(scheds)}")
+
+    jax.clear_caches()
+    gc.collect()
+    exhaustive = autotune(
+        spec, batch, devices, axes=axes, caps=caps, steps=steps,
+        repeats=repeats, exhaustive=True, telemetry=tele,
+    )
+    ex_ranked = exhaustive.ranking()
+    if not any(c.axes.get("pp", 1) > 1 for c in ex_ranked):
+        raise AssertionError(
+            "exhaustive referee measured no pp>1 candidate — the "
+            "schedule path never executed"
+        )
+    ex_by_label = {c.label: c for c in ex_ranked}
+    winner = ex_ranked[0]
+    tol = float(os.environ.get("SPARKTORCH_TPU_PP_TUNE_TOL", "0.15"))
+    chosen_ex = ex_by_label.get(tuned.best_label)
+    if chosen_ex is None:
+        raise AssertionError(
+            f"chosen {tuned.best_label} missing from the exhaustive "
+            f"measurement ({sorted(ex_by_label)})"
+        )
+    winner_wall = float(winner.measured["step_wall_s"])
+    chosen_wall = float(chosen_ex.measured["step_wall_s"])
+    if tuned.best_label != winner.label and \
+            chosen_wall > winner_wall * (1.0 + tol):
+        raise AssertionError(
+            f"tuner chose {tuned.best_label} "
+            f"({chosen_wall * 1e3:.2f}ms on the exhaustive rig) but "
+            f"the exhaustive winner is {winner.label} "
+            f"({winner_wall * 1e3:.2f}ms) — over the {tol * 100:.0f}% "
+            f"tolerance"
+        )
+
+    # ---- recompile-tax leg: cold vs warm mesh='auto' ------------------
+    small = SequenceClassifier(tiny_transformer(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_len=8))
+    small_spec = ModelSpec(module=small, loss="cross_entropy",
+                           optimizer="adam",
+                           optimizer_params={"lr": 1e-3})
+    small_batch = DataBatch(
+        x=np.asarray(rng.integers(0, 64, (2 * n_dev, 8)).astype(np.int32)),
+        y=np.asarray(rng.integers(0, 2, (2 * n_dev,)).astype(np.int32)),
+        w=np.ones((2 * n_dev,), np.float32),
+    )
+
+    def _auto_build_and_step():
+        """One mesh='auto' build + first step under a fresh ledger;
+        returns (tune_result, ledger snapshot, build wall)."""
+        led = goodput_mod.GoodputLedger(telemetry=None, rank=0)
+        tb = time.perf_counter()
+        with led.activate():
+            run = make_sharded_train_step(
+                small.apply, small_spec.loss_fn(),
+                small_spec.make_optimizer(),
+                mesh="auto", spec=small_spec, sample_batch=small_batch,
+                tune_kwargs={"steps": 1, "repeats": 1, "min_rounds": 1,
+                             "measure_top_k": 2, "cache": True},
+            )
+            state = run.state
+            if isinstance(state, PipelineState):
+                out = run(state, small_batch)
+            else:
+                out = run(state, shard_batch(small_batch, run.mesh))
+            jax.block_until_ready(jax.tree.leaves(out)[:1])
+        wall = time.perf_counter() - tb
+        led.close()
+        return run.tune_result, led.snapshot(), wall
+
+    with tempfile.TemporaryDirectory() as tune_cache_dir:
+        # Sandbox BOTH caches the auto path touches: the tune-result
+        # cache (cold-vs-warm is the leg's subject) and the XLA-cache
+        # arming knob — if this config runs in a process where the
+        # bench harness has not already armed a cache dir,
+        # _maybe_arm_xla_cache must land in the sandbox, never in the
+        # operator's ~/.cache.
+        old_env = {k: os.environ.get(k)
+                   for k in ("SPARKTORCH_TPU_TUNE_CACHE",
+                             "SPARKTORCH_TPU_XLA_CACHE")}
+        os.environ["SPARKTORCH_TPU_TUNE_CACHE"] = tune_cache_dir
+        os.environ["SPARKTORCH_TPU_XLA_CACHE"] = os.path.join(
+            tune_cache_dir, "xla")
+        try:
+            cold_result, cold_doc, cold_wall = _auto_build_and_step()
+            jax.clear_caches()
+            gc.collect()
+            warm_result, warm_doc, warm_wall = _auto_build_and_step()
+        finally:
+            for k, v in old_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    if not warm_result.cache_hit:
+        raise AssertionError("warm mesh='auto' build missed the "
+                             "tune-result cache")
+    if warm_result.compile_count >= cold_result.compile_count:
+        raise AssertionError(
+            f"cache-warm compile_count {warm_result.compile_count} did "
+            f"not drop below the cold path's "
+            f"{cold_result.compile_count}"
+        )
+    cold_compile_s = float(cold_doc["buckets"]["compile"])
+    warm_compile_s = float(warm_doc["buckets"]["compile"])
+    if cold_compile_s <= 0:
+        raise AssertionError("cold build's goodput compile bucket is "
+                             "empty — the tune LedgerSpans never landed")
+    if warm_compile_s >= cold_compile_s:
+        raise AssertionError(
+            f"goodput compile bucket shows no saving: cold "
+            f"{cold_compile_s:.2f}s vs warm {warm_compile_s:.2f}s"
+        )
+    # A cache-hit TuneResult reports the wall THIS process paid (the
+    # lookup), not the stored search's — so the collapse is direct.
+    if warm_result.wall_s > 0.2 * cold_result.wall_s + 0.5:
+        raise AssertionError(
+            f"warm tune wall {warm_result.wall_s:.2f}s did not "
+            f"collapse vs cold {cold_result.wall_s:.2f}s (cache hit "
+            f"should skip the search)"
+        )
+
+    # ---- drift gate vs the windowed prior ----------------------------
+    drift = {"status": "no_prior_record"}
+    prior = _prior_window("pp_tune", "tuner_wall_s", k=3)
+    if prior is not None:
+        dtol = float(os.environ.get("SPARKTORCH_TPU_PP_TUNE_DRIFT_TOL",
+                                    "1.0"))
+        floor_s = 5.0
+        bound = prior["median"] * (1.0 + dtol) + floor_s
+        if tuner_wall_s > bound:
+            raise AssertionError(
+                f"tuner wall {tuner_wall_s:.1f}s drifted past "
+                f"{bound:.1f}s (prior median {prior['median']:.1f}s "
+                f"over {prior['n']} rounds, tol {dtol})"
+            )
+        drift = {"status": "checked", "prior_median_s": prior["median"],
+                 "bound_s": round(bound, 1), "tolerance": dtol}
+
+    return {
+        "config": "pp_tune", "unit": "chosen step wall vs best (x)",
+        "value": round(chosen_wall / winner_wall, 4),
+        "chosen": tuned.best_label,
+        "chosen_schedule": tuned.best_schedule,
+        "exhaustive_winner": winner.label,
+        "chosen_wall_ms": round(chosen_wall * 1e3, 3),
+        "winner_wall_ms": round(winner_wall * 1e3, 3),
+        "tolerance": tol,
+        "n_candidates": len(tuned.candidates),
+        "n_pp_candidates": len(pp_cands),
+        "schedules_in_space": sorted(scheds),
+        "n_pruned": len(pruned),
+        "tuner_wall_s": round(tuner_wall_s, 1),
+        "exhaustive_wall_s": round(exhaustive.wall_s, 1),
+        "exhaustive_ranking": [
+            {"mesh": c.label,
+             "wall_ms": round(float(c.measured["step_wall_s"]) * 1e3, 3),
+             "bubble": round(float(
+                 c.predicted.get("pp_bubble_fraction", 0.0)), 3)}
+            for c in ex_ranked
+        ],
+        "compile_count_cold": cold_result.compile_count,
+        "compile_count_warm": warm_result.compile_count,
+        "compile_s_cold": round(cold_compile_s, 2),
+        "compile_s_warm": round(warm_compile_s, 2),
+        "tune_wall_cold_s": round(cold_result.wall_s, 2),
+        "tune_wall_warm_s": round(warm_result.wall_s, 3),
+        "build_wall_cold_s": round(cold_wall, 1),
+        "build_wall_warm_s": round(warm_wall, 1),
+        "drift": drift,
+        "n_chips": n_dev,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
 CONFIGS: Dict[str, Callable[[], dict]] = {
     "mnist_mlp_sync": bench_mnist_mlp_sync,
     "mnist_cnn_sync": bench_mnist_cnn_sync,
@@ -4427,6 +4710,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "sharded_trace": bench_sharded_trace,
     "gang_obs": bench_gang_obs,
     "mesh_tune": bench_mesh_tune,
+    "pp_tune": bench_pp_tune,
     "moe_a2a": bench_moe_a2a,
     "bert_dp": bench_bert_dp,
     "resnet50_inference": bench_resnet50_inference,

@@ -388,6 +388,121 @@ def _moe_groups(cfg: TransformerConfig, n: int) -> Tuple[int, int]:
     return moe_group_partition(cfg, n)
 
 
+def pp_moe_group_size(cfg: TransformerConfig, n_tokens: int,
+                      n_ep: int) -> Optional[int]:
+    """The a2a grouping OPT-IN for MoE inside a pp schedule: the
+    largest group size ``g <= cfg.moe_group_size`` that partitions
+    ``n_tokens`` (one microbatch's tokens per dp/sp shard) into a
+    group count divisible by ``n_ep`` — exactly the group-size choice
+    the gpipe-ep dryrun config makes by hand, so the 'auto' dispatch
+    (:func:`_moe_ffn_ep_dispatch`) takes the all-to-all layout instead
+    of silently falling back to token replication. Returns None when
+    no such size exists (the replicated fallback is then the only
+    layout, and the caller should leave the config untouched). The
+    pp group partition is deliberately un-anchored (see
+    :func:`_moe_groups`), which is why the opt-in must come from the
+    group SIZE rather than a mesh-derived partition."""
+    if n_ep <= 1 or n_tokens <= 0:
+        return None
+    cap = max(1, int(cfg.moe_group_size))
+    for g in range(min(cap, n_tokens), 0, -1):
+        if n_tokens % g == 0 and (n_tokens // g) % n_ep == 0:
+            return g
+    return None
+
+
+def pp_moe_opt_in_cfg(cfg: TransformerConfig, rows: int, seq: int,
+                      dp: int, sp: int, ep: int,
+                      n_micro: int) -> TransformerConfig:
+    """Apply :func:`pp_moe_group_size` to a config about to build a
+    pp step: returns ``cfg`` with ``moe_group_size`` replaced by the
+    a2a opt-in when one exists for this (batch, mesh, n_micro)
+    partition, or unchanged otherwise. The ONE definition both the
+    tuner's measured candidate and the ``mesh='auto'`` winner build
+    go through — the two must agree or the measured layout is not
+    the one production pays for."""
+    if cfg.n_experts <= 0 or ep <= 1:
+        return cfg
+    tokens = (rows // max(1, dp) // max(1, n_micro)) * (seq // max(1, sp))
+    gs = pp_moe_group_size(cfg, tokens, ep)
+    if gs is not None and gs != cfg.moe_group_size:
+        return dataclasses.replace(cfg, moe_group_size=gs)
+    return cfg
+
+
+def build_pp_schedule_step(spec, mesh: Mesh,
+                           schedule_meta, rows: int, seq: int,
+                           tx: Optional[
+                               optax.GradientTransformation] = None,
+                           rng: Optional[jax.Array] = None,
+                           sample_x=None):
+    """Build a pipeline-scheduled step from a ``ModelSpec`` + a tuner
+    schedule meta (``{"schedule": gpipe|1f1b|interleaved,
+    "virtual_stages": V, "n_micro": M}``) — THE one build path shared
+    by the tuner's measured candidate
+    (:func:`sparktorch_tpu.parallel.tune.prepare_pipeline_candidate`)
+    and the ``mesh='auto'`` winner
+    (:func:`sparktorch_tpu.train.sharded._make_auto_pipeline_step`),
+    so the measured layout and the production step cannot diverge.
+
+    Validates the meta (schedule name, rows % (dp x n_micro)), picks
+    the head from the module type, threads the MoE a2a group-size
+    opt-in (:func:`pp_moe_opt_in_cfg`), restacks the spec's flax
+    params into the pipeline layout (interleave-permuted for
+    ``virtual_stages > 1``), places the state over ``mesh``, and
+    returns ``(state, step, cfg_used, head)`` — no dispatch happens
+    here, so callers own their compile accounting."""
+    from sparktorch_tpu.models.transformer import CausalLM
+
+    meta = dict(schedule_meta or {})
+    if not meta:
+        raise ValueError("pp>1 build requires a schedule meta")
+    sched = str(meta.get("schedule"))
+    if sched not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(f"unknown pipeline schedule {sched!r}")
+    v_stages = int(meta.get("virtual_stages", 1))
+    n_micro = int(meta["n_micro"])
+    # "interleaved" is the search-space name; this trainer spells it
+    # schedule='1f1b' + virtual_stages=V.
+    pp_schedule = "1f1b" if sched in ("1f1b", "interleaved") else "gpipe"
+
+    tx = tx or spec.make_optimizer()
+    module = spec.make_module()
+    cfg = getattr(module, "config", None)
+    if cfg is None or not hasattr(cfg, "d_model"):
+        raise ValueError(
+            "pipeline schedules need a transformer ModelSpec "
+            f"(got {type(module).__name__})"
+        )
+    head = "lm" if isinstance(module, CausalLM) else "classifier"
+    sizes = dict(mesh.shape)
+    dp = sizes[AXIS_DP]
+    if rows % (dp * n_micro) != 0:
+        raise ValueError(
+            f"batch rows {rows} not divisible by dp({dp}) x "
+            f"n_micro({n_micro})"
+        )
+    cfg = pp_moe_opt_in_cfg(cfg, rows, seq, dp,
+                            sizes.get(AXIS_SP, 1),
+                            sizes.get(AXIS_EP, 1), n_micro)
+    if rng is None:
+        rng = jax.random.key(0)
+    if sample_x is None:
+        sample_x = np.zeros((1, seq), np.int32)
+    flax_params = dict(spec.init_params(
+        rng, sample_x=np.asarray(sample_x)))["params"]
+    pparams = pipeline_params_from_flax(flax_params, cfg)
+    if v_stages > 1:
+        pparams = apply_interleave_permutation(
+            pparams, cfg, sizes[AXIS_PP], v_stages)
+    state = place_pipeline_state(pparams, tx, mesh)
+    step = make_pp_train_step(
+        cfg, tx, mesh, n_micro=n_micro, head=head,
+        schedule=pp_schedule, virtual_stages=v_stages,
+    )
+    return state, step, cfg, head
+
+
 def _moe_route(cfg: TransformerConfig, mp, tokens, mask, cap: int):
     """Router + GShard capacity assignment for a block of routing
     groups — the exact routing math of

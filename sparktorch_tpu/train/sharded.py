@@ -153,6 +153,162 @@ def _opt_state_shardings(a_opt, a_params, param_sh, mesh: Mesh):
     return jax.tree.map(pick, a_opt)
 
 
+# Env knob for the auto path's persistent-compile-cache arming:
+# unset/1 arms (default ~/.cache/sparktorch_tpu/xla), 0/off disables,
+# any other value is the cache directory.
+XLA_CACHE_ENV = "SPARKTORCH_TPU_XLA_CACHE"
+
+
+def _make_finish(loop_state):
+    """The shared ``run.finish()`` for both auto paths (GSPMD and
+    pipeline winners): end an in-flight XLA capture, return the
+    published :class:`TraceAnalysis` (or None), and upgrade an active
+    goodput ledger's comm model to 'measured' from the analysis."""
+    from sparktorch_tpu.obs import goodput as _goodput
+
+    def finish():
+        profiler, loop_state["profiler"] = loop_state["profiler"], None
+        if profiler is not None:
+            profiler.__exit__(None, None, None)
+        handle, loop_state["handle"] = loop_state["handle"], None
+        analysis = handle["analysis"] if handle else None
+        ledger = _goodput.active()
+        if ledger is not None and analysis is not None:
+            ledger.apply_analysis(analysis)
+        return analysis
+
+    return finish
+
+
+def _maybe_arm_xla_cache() -> bool:
+    """Arm the jax persistent compilation cache for ``mesh='auto'``
+    builds (see :func:`sparktorch_tpu.utils.checkpoint.
+    arm_persistent_cache` for the restore-safety rules)."""
+    import os
+
+    env = (os.environ.get(XLA_CACHE_ENV) or "").strip()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("", "1", "true", "on"):
+        cache_dir = os.path.join(os.path.expanduser("~"), ".cache",
+                                 "sparktorch_tpu", "xla")
+    else:
+        cache_dir = env
+    from sparktorch_tpu.utils.checkpoint import arm_persistent_cache
+
+    return arm_persistent_cache(cache_dir)
+
+
+def _make_auto_pipeline_step(spec, tx, mesh, tune_result, rng,
+                             sample_batch: DataBatch,
+                             profile_dir: Optional[str] = None,
+                             telemetry=None):
+    """Build the ``mesh='auto'`` fast path for a PIPELINE winner: the
+    tuner picked a pp>1 candidate (``tune_result.best_schedule`` names
+    the schedule / virtual_stages / n_micro it measured), so the
+    returned ``run`` dispatches through
+    :func:`sparktorch_tpu.train.pipeline.make_pp_train_step` — the
+    same schedule path the candidate was measured through — with the
+    usual auto extras (``run.state`` is the initial
+    :class:`~sparktorch_tpu.train.pipeline.PipelineState`,
+    ``run.mesh``, ``run.tune_result``, ``run.finish``) plus
+    ``run.pipeline_schedule`` (the schedule meta) and
+    ``run.eval_loss``. Batches fed to ``run`` must keep rows
+    divisible by dp x n_micro (the sample batch the tuner measured
+    already is). MoE winners with ep>1 get the a2a grouping opt-in
+    threaded through the built step (``pp_moe_group_size``), so the
+    production step runs the same dispatch layout the measured
+    candidate did."""
+    import numpy as np
+
+    from sparktorch_tpu.obs import get_telemetry
+    from sparktorch_tpu.obs import goodput as _goodput
+    from sparktorch_tpu.train.pipeline import (
+        PipelineState,
+        build_pp_schedule_step,
+    )
+
+    meta = dict(tune_result.best_schedule or {})
+    if not meta:
+        raise ValueError(
+            "pp>1 tune winner carries no schedule meta — re-run the "
+            "search (pre-schedule cache entries are fenced by the "
+            "cache-key schema bump)"
+        )
+    rows = int(sample_batch.x.shape[0])
+    seq = (int(sample_batch.x.shape[1])
+           if np.asarray(sample_batch.x).ndim >= 2 else 1)
+    # The ONE shared build recipe (validation, head pick, MoE a2a
+    # group opt-in, restack + interleave + placement) — the same path
+    # the tuner measured the winner through.
+    auto_state, step, _cfg, _head = build_pp_schedule_step(
+        spec, mesh, meta, rows, seq, tx=tx, rng=rng,
+        sample_x=sample_batch.x[:1],
+    )
+
+    from sparktorch_tpu.utils.tracing import profile_run, step_annotation
+
+    tele = telemetry or get_telemetry()
+    loop_state = {"calls": 0, "profiler": None, "handle": None}
+    est_comm_fraction = None
+    ranking = tune_result.ranking()
+    if ranking and ranking[0].measured:
+        est_comm_fraction = float(
+            ranking[0].measured.get("exposed_comm_fraction", 0.0))
+
+    def run(state: PipelineState, batch: DataBatch):
+        if profile_dir and loop_state["profiler"] is None:
+            loop_state["profiler"] = profile_run(profile_dir,
+                                                 telemetry=tele)
+            loop_state["handle"] = loop_state["profiler"].__enter__()
+        step_no = loop_state["calls"]
+        loop_state["calls"] += 1
+        ledger = _goodput.active()
+        if ledger is None:
+            with tele.span("train_sharded/step"), \
+                    step_annotation(step_no, telemetry=tele):
+                return step(state, batch)
+        # Same ledger contract as the GSPMD run: synced step span,
+        # re-aimed at ``compile`` when the schedule's jit dispatch
+        # cache grew under the call (the winner's fresh-closure
+        # recompile lands on the TuneResult's compile bill).
+        if est_comm_fraction is not None:
+            ledger.set_comm_model(est_comm_fraction, "estimate")
+        cache0 = step.jit_cache_size()
+        with tele.span("train_sharded/step"), \
+                step_annotation(step_no, telemetry=tele):
+            with ledger.step_span() as led:
+                out = step(state, batch)
+                cache1 = step.jit_cache_size()
+                if cache0 is not None and cache1 is not None \
+                        and cache1 > cache0:
+                    led.rebucket("compile")
+                elif cache0 is None and cache1 is not None \
+                        and cache1 > 0 and step_no == 0:
+                    # First call: the probe reads None before the
+                    # lazily-built jitted exists, so a grown cache
+                    # after the call IS the compile signal.
+                    led.rebucket("compile")
+                jax.block_until_ready(out[1])
+        if led.bucket == "compile":
+            tele.counter("goodput.compiles_total",
+                         labels={"site": "train_sharded"})
+            tune_result.compile_count += 1
+            tune_result.compile_s_total += float(led.duration_s)
+        return out
+
+    run.jitted = None              # pipeline jit is lazily built
+    run.mesh = mesh
+    run.finish = _make_finish(loop_state)
+    run.state = auto_state
+    run.shardings = None           # pipeline layout lives in the step
+    run.tune_result = tune_result
+    run.pipeline_schedule = meta
+    run.pipeline_step = step
+    run.eval_loss = step.eval_loss
+    return run
+
+
 def make_sharded_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -181,7 +337,18 @@ def make_sharded_train_step(
     :class:`TrainState`), ``run.shardings``, and ``run.tune_result``
     beside the usual ``run.mesh`` — callers start the loop from
     ``run.state`` instead of calling :func:`create_sharded_state`
-    themselves (the mesh was not known until now). Known cost: the
+    themselves (the mesh was not known until now). When the tuner's
+    winner has pp>1 the returned ``run`` is a PIPELINE-scheduled step
+    instead (same contract; ``run.state`` is a ``PipelineState``,
+    ``run.pipeline_schedule`` names the winning schedule — see
+    :func:`_make_auto_pipeline_step`). CONTRACT: that pipeline step
+    derives its apply/loss from ``spec`` (head-typed cross entropy,
+    like every train_distributed pp dispatch), NOT from the
+    ``apply_fn``/``loss_fn`` arguments — the search only opens pp
+    when ``spec.loss`` is in the cross-entropy family, so callers
+    passing a loss_fn that does not match their spec's loss must pin
+    ``tune_kwargs={'axes': GSPMD_AXES}`` to stay on the GSPMD path.
+    Known cost: the
     winner's GSPMD program compiles once inside the tuner's
     measurement and once more for this fresh step closure (jit cannot
     dedupe across closures) — amortized over a training run; RE-runs
@@ -226,11 +393,33 @@ def make_sharded_train_step(
         # every candidate) — SPARKTORCH_TPU_TUNE_CACHE=0 opts out,
         # tune_kwargs={'cache': False} opts out per call.
         tune_kwargs.setdefault("cache", True)
+        # Recompile tax (ROADMAP 4b): arm the PERSISTENT compile cache
+        # for the auto path, so the winner's known second compile (the
+        # tuner's measurement closure, then this fresh step closure —
+        # jit cannot dedupe across closures) is a disk hit instead of
+        # a full XLA compile, and the next process warm-starts the
+        # whole search's compiles. SPARKTORCH_TPU_XLA_CACHE=0 opts
+        # out; a path value relocates the cache dir. arm_persistent_
+        # cache refuses after an orbax restore (the restore <->
+        # cache-mediated-collective SIGABRT its disarm hook exists
+        # for) and defers to an already-configured cache dir.
+        _maybe_arm_xla_cache()
         tune_result = autotune(
             spec, sample_batch, devices, tx=tx, seq_sharded=seq_sharded,
             telemetry=telemetry, **tune_kwargs,
         )
         mesh = build_mesh(tune_result.best_config(), devices)
+        if int(tune_result.best.get("pp", 1)) > 1:
+            # The winner is a PIPELINE schedule: hand back a
+            # pipeline-scheduled step (same run/finish/introspection
+            # contract) instead of forcing the mesh through the
+            # schedule-less GSPMD trainer.
+            return _make_auto_pipeline_step(
+                spec, tx, mesh, tune_result,
+                rng if rng is not None else jax.random.key(0),
+                sample_batch, profile_dir=profile_dir,
+                telemetry=telemetry,
+            )
         auto_state, state_shardings = create_sharded_state(
             spec, mesh,
             rng if rng is not None else jax.random.key(0),
@@ -361,26 +550,11 @@ def make_sharded_train_step(
                 tune_result.compile_s_total += float(led.duration_s)
         return out
 
-    def finish():
-        """End an in-flight XLA trace capture (no-op otherwise) and
-        return the published :class:`TraceAnalysis` (or None). An
-        active goodput ledger adopts the analysis's measured exposed-
-        comm fraction — the estimate-to-measured upgrade."""
-        profiler, loop_state["profiler"] = loop_state["profiler"], None
-        if profiler is not None:
-            profiler.__exit__(None, None, None)
-        handle, loop_state["handle"] = loop_state["handle"], None
-        analysis = handle["analysis"] if handle else None
-        ledger = _goodput.active()
-        if ledger is not None and analysis is not None:
-            ledger.apply_analysis(analysis)
-        return analysis
-
     # Introspection hooks (tests assert on the compiled HLO — e.g. that
     # the MoE layout constraints actually lower to all-to-alls).
     run.jitted = jitted
     run.mesh = mesh
-    run.finish = finish
+    run.finish = _make_finish(loop_state)
     # Auto-tune extras (None unless mesh="auto"): the initial state in
     # the winning layout, its shardings, and the search record.
     run.state = auto_state

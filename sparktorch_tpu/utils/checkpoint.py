@@ -24,24 +24,118 @@ import orbax.checkpoint as ocp
 from sparktorch_tpu.obs import goodput as _goodput
 
 
+# How many orbax restores have run in this process. Read by
+# arm_persistent_cache (arming after a restore would re-create the
+# crash the disarm exists to prevent) and by tests pinning the
+# disarm-really-disarms contract.
+_RESTORE_COUNT = 0
+
+
+def restore_count() -> int:
+    """Orbax restores seen by this process (any of the module's
+    restore paths). Nonzero means the persistent compilation cache has
+    been disarmed for the remainder of the process on CPU."""
+    return _RESTORE_COUNT
+
+
 def _disarm_persistent_cache_after_restore() -> None:
-    """Work around a jax-0.4.x CPU crash: executing a persistent-
-    compilation-cache DESERIALIZED executable with collectives after an
-    orbax restore has run in the same process segfaults in pxla
-    ``__call__`` (reproduced deterministically: train+save, then
-    resume — the resumed step's cache-hit executable crashes; a fresh
-    compile of the identical program is fine). Until the runtime is
-    fixed, a restore flips the persistent cache OFF for the remainder
-    of the process: everything before the first restore still gets
-    cache speed, and resumed runs pay one fresh compile instead of a
-    segfault."""
+    """Work around a jax-0.4.x CPU crash: an orbax restore anywhere in
+    the process, followed by compiling/dispatching collective programs
+    THROUGH the armed persistent compilation cache, SIGABRTs in
+    dispatch (bisected in tests/conftest.py: restore -> streaming
+    trainer's collectives aborts deterministically even on a COLD
+    cache dir; the same programs compiled with the cache off are
+    fine). Until the runtime is fixed, a restore flips the persistent
+    cache OFF for the remainder of the process: everything before the
+    first restore still gets cache speed, and resumed runs pay fresh
+    compiles instead of a segfault.
+
+    Nulling ``jax_compilation_cache_dir`` alone is NOT a disarm once
+    any compile has happened: jax's ``compilation_cache.is_cache_used``
+    latches a module-global ``_cache_used`` at the first compile and
+    ``_get_cache`` keeps serving the already-initialized cache object
+    — the config flip is invisible to both (verified against this
+    build; the bisected pair crashed WITH the config-only hook in
+    place, leaving the runtime in a half-disabled state: latched-on
+    reads against config-gated writes). ``reset_cache()`` drops the
+    latch and the cache object, so the next compile re-evaluates the
+    (now null) config and runs uncached.
+
+    A softer "reset but keep the dir armed" variant (post-restore
+    compiles get a coherent FRESH cache) was tried and REJECTED: the
+    checkpoint+train_sync suite still aborts under it — the crash is
+    the restore <-> cache-mediated collective interaction itself, not
+    stale latch state. Disarm-for-the-rest-of-the-process is the only
+    mode the full suite survives."""
+    global _RESTORE_COUNT
+    _RESTORE_COUNT += 1
     if jax.default_backend() != "cpu":
         return
     try:
-        if jax.config.jax_compilation_cache_dir:
-            jax.config.update("jax_compilation_cache_dir", None)
+        if not jax.config.jax_compilation_cache_dir:
+            return
+        jax.config.update("jax_compilation_cache_dir", None)
     except AttributeError:  # config knob renamed/absent on this build
+        return
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 - private API; degrade to config-only
         pass
+
+
+def persistent_cache_armed() -> bool:
+    """Whether the jax persistent compilation cache is currently
+    armed (a cache dir is configured)."""
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except AttributeError:
+        return False
+
+
+def arm_persistent_cache(cache_dir: str,
+                         min_compile_time_s: float = 0.3) -> bool:
+    """Arm the jax persistent compilation cache at ``cache_dir`` —
+    the runtime-level antidote to the recompile tax (ROADMAP item 4b):
+    every XLA compile past ``min_compile_time_s`` serializes to disk,
+    and an identical program compiled later (a fresh jit closure, the
+    mesh='auto' winner's second compile, the next process) is a disk
+    hit instead of a recompile.
+
+    Refuses (returns False) when a restore already ran in this
+    process ON THE CPU BACKEND — arming then would re-create the
+    restore↔collective SIGABRT the disarm hook exists to prevent
+    (the crash never reproduces off-CPU, so restores there don't
+    forfeit the cache). When a cache dir is already configured the
+    call defers to it and returns True (first armer wins; the return
+    means "a cache is armed", not "YOUR dir is armed"). Mid-process
+    arming needs the same ``reset_cache()`` un-latch as the disarm:
+    jax latches "no cache" at the first uncached compile."""
+    if _RESTORE_COUNT > 0 and jax.default_backend() == "cpu":
+        return False
+    if persistent_cache_armed():
+        return True
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except AttributeError:
+        # A knob renamed on this build: never leave the cache HALF
+        # armed (dir set, thresholds defaulted, latch not reset).
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except AttributeError:
+            pass
+        return False
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 - private API; the latch may bite
+        pass
+    return True
 
 
 _ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp"

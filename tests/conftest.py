@@ -33,46 +33,63 @@ jax.config.update("jax_platforms", "cpu")
 if os.environ.get("SPARKTORCH_TPU_TEST_FASTCOMPILE"):
     jax.config.update("jax_disable_most_optimizations", True)
 
-# The persistent compilation cache is OFF by default for the suite:
-# on this jax-0.4.x CPU build, EXECUTING a deserialized cached
-# executable that contains collectives segfaults/aborts in pxla
-# __call__ — same-session entries included (reproduced minimally:
-# train leg A compiles+writes, train leg B of the identical program
-# gets a cache hit and its first dispatch segfaults; cross-session
-# stale entries crash the same way). One crash kills the whole pytest
-# process, losing every remaining test — strictly worse than the
-# recompilation it saves. CheckpointManager additionally disarms a
-# runtime-enabled cache after any orbax restore (utils/checkpoint.py)
-# for non-test runs that opt in.
-# Full-suite trial, 2026-08-03 (the ROADMAP recheck's next step): RED.
-# `SPARKTORCH_TPU_TEST_CACHE=<dir> make test-fast` segfaults
-# deterministically ~20s in, inside tests/test_checkpoint.py.
-# BISECTED (same day): the crasher is
-# tests/test_checkpoint.py::test_streaming_trainer_checkpoint_resume,
-# and the trigger is ANY earlier in-process orbax restore: every test
-# of the file passes ALONE (cold cache each), the save-only pair
-# (test_checkpoint_cadence_under_fused_stepping -> streaming) passes,
-# but every restore-first pair aborts inside the streaming test —
-# including test_model_save_load -> streaming, where the predecessor
-# only does load_model (orbax restore, NO training, NO collectives).
-# Reverse order (streaming first, restorer second) is green. So the
-# repro is: orbax restore anywhere in the process, THEN the streaming
-# trainer compiling/dispatching its collective programs with the
-# persistent cache armed -> SIGABRT in dispatch. (Consistent with
-# utils/checkpoint.py having to disarm a runtime-enabled cache after
-# restore for non-test runs — the restore leaves the runtime in a
-# state where cache-mediated collective executables abort.) The
-# default therefore STAYS off; do not flip it until a full
-# `make test-fast` survives twice.
-# SPARKTORCH_TPU_TEST_CACHE=<dir> opts a session into a cache dir (at
-# your own risk, e.g. on a TPU backend where the bug doesn't bite).
+# The persistent compilation cache is ARMED by default for the suite
+# (a fresh per-session tmp dir), re-enabled after the restore <->
+# collective SIGABRT was chased into the runtime (ROADMAP 4b):
+# HISTORY (2026-08-03 bisect, kept because each clue was hard-won):
+# with the cache armed, the suite aborted deterministically inside
+# tests/test_checkpoint.py::test_streaming_trainer_checkpoint_resume
+# whenever ANY earlier in-process orbax restore had run — even
+# test_model_save_load -> streaming, where the predecessor only does
+# load_model (restore, no training, no collectives); every test alone
+# was green (cold cache), the save-only pair was green, the reverse
+# order was green. So: orbax restore anywhere in the process, THEN
+# cache-mediated collective compile/dispatch -> SIGABRT.
+# ROOT CAUSE OF THE LINGERING CRASH (2026-08-04): the disarm hook in
+# utils/checkpoint.py nulled jax_compilation_cache_dir, but on this
+# jax that is NOT a disarm once any compile has happened —
+# compilation_cache.is_cache_used LATCHES a module-global at the
+# first compile and _get_cache keeps serving the initialized cache
+# object, so the "disarmed" runtime kept using the cache and aborted.
+# The hook now also calls compilation_cache.reset_cache() (drops the
+# latch + cache object), after which the bisected pair and the full
+# suite run green with the cache armed. A softer reset-but-keep-
+# armed mode was tried and still aborts (see the hook's docstring) —
+# after the first restore the process runs uncached, which is the
+# safe trade. Everything BEFORE the first restore (and any session
+# without one) gets persistent-cache speed.
+# Knobs:
+# - SPARKTORCH_TPU_TEST_CACHE=0|off  -> cache disarmed (old default)
+# - SPARKTORCH_TPU_TEST_CACHE=<dir> -> that dir (persistent across
+#   sessions; safe — pre-restore deserialized collective execution
+#   is green, reproduced in tests/test_checkpoint.py's cache tests)
+# - unset -> fresh tmp dir for this session
+# - SPARKTORCH_TPU_ISOLATE_STREAMING=1 -> the streaming-trainer
+#   checkpoint test re-runs itself in a SUBPROCESS (fresh process =
+#   no prior restore = cache armed all the way through it); the
+#   escape hatch for rigs where the in-process disarm is not enough.
 _CACHE_DIR = os.environ.get("SPARKTORCH_TPU_TEST_CACHE")
 if _CACHE_DIR in ("0", "off"):
     _CACHE_DIR = None
+elif not _CACHE_DIR:
+    import atexit
+    import shutil
+    import tempfile
+
+    _CACHE_DIR = tempfile.mkdtemp(prefix="sparktorch_tpu_xla_cache_")
+    # Session-scoped: nothing re-reads a fresh dir after the session,
+    # so leaving it behind would be a pure disk leak on a TDD loop.
+    atexit.register(shutil.rmtree, _CACHE_DIR, True)
 if _CACHE_DIR:
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+# The mesh="auto" builder's own persistent-cache arming
+# (SPARKTORCH_TPU_XLA_CACHE) is OFF by default for the suite: the
+# session cache above already covers the suite, and a test must never
+# write into the user's ~/.cache. Cache tests opt in explicitly.
+os.environ.setdefault("SPARKTORCH_TPU_XLA_CACHE", "0")
 
 # The tune-result cache is OFF by default for the suite: tests must
 # be hermetic (no reads of — or writes to — the user's ~/.cache, and

@@ -14,13 +14,18 @@ import pytest
 from sparktorch_tpu.parallel.mesh import MeshConfig
 from sparktorch_tpu.parallel.tune import (
     ALPHA_ENV,
+    GSPMD_AXES,
     Candidate,
     TuneResult,
     WorkloadShape,
     autotune,
     calibrate_alpha_bytes,
+    candidate_label,
     enumerate_candidates,
     mesh_label,
+    pp_bubble_fraction,
+    pp_schedule_metas,
+    pp_schedule_ticks,
     predict_comm_bytes,
     resolve_alpha_bytes,
     score_analysis,
@@ -39,15 +44,19 @@ SYNTHETIC = os.path.join(FIXTURES, "synthetic_overlap.trace.json.gz")
 
 def test_enumerate_candidates_deterministic_and_legal():
     """8 devices, tp capped by 2 heads, sp by a 4-token sequence, no
-    experts: the exact legal set, in the exact deterministic order
-    (ascending (fsdp, tp, sp, ep, pp) tuples — pure dp first)."""
+    experts, pp by a 2-layer stack: the exact legal set, in the exact
+    deterministic order (ascending (fsdp, tp, sp, ep, pp) tuples —
+    pure dp first). pp=2 meshes appear (the schedule search is opened)
+    but never combined with fsdp (no trainer runs pp x fsdp)."""
     caps = {"fsdp": (64,), "tp": (2, 128, 256), "sp": (4,), "ep": (1,),
             "pp": (2,)}
     got = [c.resolve(8) for c in enumerate_candidates(8, caps, 32)]
     labels = [mesh_label(s) for s in got]
     assert labels == [
-        "dp8", "dp4xsp2", "dp2xsp4",
-        "dp4xtp2", "dp2xtp2xsp2", "tp2xsp4",
+        "dp8", "dp4xpp2", "dp4xsp2", "dp2xsp2xpp2",
+        "dp2xsp4", "sp4xpp2",
+        "dp4xtp2", "dp2xtp2xpp2", "dp2xtp2xsp2", "tp2xsp2xpp2",
+        "tp2xsp4",
         "dp4xfsdp2", "dp2xfsdp2xsp2", "fsdp2xsp4",
         "dp2xfsdp2xtp2", "fsdp2xtp2xsp2",
         "dp2xfsdp4", "fsdp4xsp2", "fsdp4xtp2", "fsdp8",
@@ -58,10 +67,13 @@ def test_enumerate_candidates_deterministic_and_legal():
         for v in sizes.values():
             prod *= v
         assert prod == 8
-        # And respects its caps: tp | 2, sp | 4, ep == 1.
+        # And respects its caps: tp | 2, sp | 4, ep == 1, pp | 2.
         assert 2 % sizes["tp"] == 0
         assert 4 % sizes["sp"] == 0
         assert sizes["ep"] == 1
+        assert 2 % sizes["pp"] == 0
+        # No trainer runs pp x fsdp.
+        assert not (sizes["pp"] > 1 and sizes["fsdp"] > 1)
         # Batch axes divide the global batch.
         assert 32 % (sizes["dp"] * sizes["fsdp"]) == 0
     # Same inputs -> same list (determinism is what goldens pin).
@@ -292,7 +304,7 @@ def test_autotune_prunes_measures_and_ranks():
     walls["fsdp8"] = (0.008, 0.002)  # scripted winner, rank 2 by cost
     result = autotune(spec, batch, devices, steps=3, repeats=3,
                       measure_top_k=4, noise_mult=2.0,
-                      measure_fn=_fake_measure(walls),
+                      axes=GSPMD_AXES, measure_fn=_fake_measure(walls),
                       alpha_bytes=1 << 20)
     assert result.best_label == "fsdp8"
     assert not result.early_stopped and result.rounds_run == 3
@@ -326,7 +338,7 @@ def test_autotune_early_stops_on_noise_floor():
         walls[label] = (0.030, 0.0002)
     result = autotune(spec, batch, devices, steps=2, repeats=4,
                       min_rounds=2, measure_top_k=6, noise_mult=2.0,
-                      measure_fn=_fake_measure(walls),
+                      axes=GSPMD_AXES, measure_fn=_fake_measure(walls),
                       alpha_bytes=1 << 20)
     assert result.early_stopped
     assert result.best_label == "dp8"
@@ -338,7 +350,7 @@ def test_autotune_early_stops_on_noise_floor():
     noisy = {k: (w, 0.05) for k, (w, _s) in walls.items()}
     result2 = autotune(spec, batch, devices, steps=2, repeats=4,
                        min_rounds=2, measure_top_k=6, noise_mult=2.0,
-                       measure_fn=_fake_measure(noisy),
+                       axes=GSPMD_AXES, measure_fn=_fake_measure(noisy),
                        alpha_bytes=1 << 20)
     assert not result2.early_stopped
     assert result2.rounds_run == 4
@@ -365,7 +377,7 @@ def test_autotune_survives_failed_candidates():
         return runner
 
     result = autotune(spec, batch, devices, steps=2, measure_top_k=2,
-                      measure_fn=prepare, alpha_bytes=1 << 20)
+                      axes=GSPMD_AXES, measure_fn=prepare, alpha_bytes=1 << 20)
     failed = [c for c in result.candidates if c.status == "failed"]
     assert len(failed) == 1 and "compile exploded" in failed[0].reason
     assert result.best_label == calls[1]
@@ -392,7 +404,7 @@ def test_autotune_survives_mid_measure_failure():
 
     result = autotune(spec, batch, devices, steps=2, repeats=3,
                       measure_top_k=2, noise_mult=2.0,
-                      measure_fn=prepare, alpha_bytes=1 << 20)
+                      axes=GSPMD_AXES, measure_fn=prepare, alpha_bytes=1 << 20)
     # dp8 died in round 2 -> failed, dropped from later rounds; the
     # survivor wins on its own pooled rounds.
     by_label = {c.label: c for c in result.candidates}
@@ -414,7 +426,7 @@ def test_tune_result_artifact_roundtrip(tmp_path):
         "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"])}
     path = str(tmp_path / "tune_result.json")
     result = autotune(spec, batch, devices, steps=2, measure_top_k=3,
-                      measure_fn=_fake_measure(walls),
+                      axes=GSPMD_AXES, measure_fn=_fake_measure(walls),
                       alpha_bytes=1 << 20, artifact_path=path)
     loaded = TuneResult.load(path)
     assert loaded.to_dict() == result.to_dict()
@@ -449,7 +461,7 @@ def test_tune_result_compile_bill_stamped(tmp_path):
         "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"])}
     path = str(tmp_path / "tune_result.json")
     result = autotune(spec, batch, devices, steps=2, measure_top_k=3,
-                      measure_fn=_fake_measure(walls),
+                      axes=GSPMD_AXES, measure_fn=_fake_measure(walls),
                       alpha_bytes=1 << 20, artifact_path=path)
     assert result.compile_count == 3  # one per prepared candidate
     assert result.compile_s_total == pytest.approx(3.0)
@@ -481,7 +493,7 @@ def test_tune_result_compile_bill_stamped(tmp_path):
         return runner
 
     result2 = autotune(spec, batch, devices, steps=2, measure_top_k=2,
-                       measure_fn=prepare, alpha_bytes=1 << 20)
+                       axes=GSPMD_AXES, measure_fn=prepare, alpha_bytes=1 << 20)
     assert result2.compile_count == 1
     assert result2.compile_s_total == pytest.approx(0.5)
 
@@ -496,7 +508,7 @@ def test_tune_publish_puts_xprof_tune_on_the_bus(tmp_path):
         "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"]}
     tele = Telemetry(run_id="tune_pub")
     result = autotune(spec, batch, devices, steps=3, measure_top_k=2,
-                      measure_fn=_fake_measure(walls),
+                      axes=GSPMD_AXES, measure_fn=_fake_measure(walls),
                       alpha_bytes=1 << 20, telemetry=tele)
     snap = tele.snapshot()
     assert snap["counters"]["xprof.tune_runs_total"] == 1
@@ -527,7 +539,7 @@ def test_timeline_tune_cli(tmp_path, capsys):
         "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"]}
     path = str(tmp_path / "tune_result.json")
     autotune(spec, batch, list(range(8)), steps=2, measure_top_k=2,
-             measure_fn=_fake_measure(walls), alpha_bytes=1 << 20,
+             axes=GSPMD_AXES, measure_fn=_fake_measure(walls), alpha_bytes=1 << 20,
              artifact_path=path)
     assert timeline_main([path, "--tune"]) == 0
     out = capsys.readouterr().out
@@ -761,8 +773,328 @@ def test_scripted_and_exhaustive_searches_never_touch_cache(
         "dp4xtp2", "dp2xtp4", "fsdp2xtp4", "dp2xfsdp2xtp2"]}
     result = autotune(spec, batch, devices, steps=1, repeats=1,
                       min_rounds=1, measure_top_k=2,
-                      measure_fn=_fake_measure(walls),
+                      axes=GSPMD_AXES, measure_fn=_fake_measure(walls),
                       alpha_bytes=1 << 20, cache=True)
     assert result.cache_hit is False
     assert not [p for p in os.listdir(tmp_path)
                 if p.startswith("tune_")]
+
+
+# ---------------------------------------------------------------------------
+# Pipeline schedules in the search space (ROADMAP item 4a)
+# ---------------------------------------------------------------------------
+
+
+def test_pp_bubble_and_ticks_closed_form():
+    """The schedule terms are the textbook numbers: gpipe and 1f1b
+    share the (S-1)/(M+S-1) bubble (1F1B reorders it for memory, not
+    away); interleaved shrinks it to (S-1)/(V*M+S-1) and pays V x the
+    ticks."""
+    assert pp_bubble_fraction("gpipe", 1, 4) == 0.0
+    assert pp_bubble_fraction("gpipe", 2, 4) == pytest.approx(1 / 5)
+    assert pp_bubble_fraction("1f1b", 2, 4) == pytest.approx(1 / 5)
+    assert pp_bubble_fraction("gpipe", 4, 8) == pytest.approx(3 / 11)
+    assert pp_bubble_fraction("interleaved", 2, 4, 2) == pytest.approx(
+        1 / 9)
+    # More microbatches or more virtual stages -> smaller bubble.
+    assert pp_bubble_fraction("gpipe", 2, 8) < pp_bubble_fraction(
+        "gpipe", 2, 4)
+    assert pp_bubble_fraction("interleaved", 2, 4, 4) < \
+        pp_bubble_fraction("interleaved", 2, 4, 2)
+    assert pp_schedule_ticks("gpipe", 2, 4) == 5
+    assert pp_schedule_ticks("1f1b", 2, 4) == 6
+    assert pp_schedule_ticks("interleaved", 2, 4, 2) == 10
+    assert pp_schedule_ticks("gpipe", 1, 4) == 0
+
+
+def test_cost_model_pp_schedule_terms():
+    """The pp_send_recv term is schedule-aware: the bubble rides as a
+    multiplicative penalty, interleaved chunks multiply the boundary
+    bytes by V, and the alpha term charges one launch per tick per
+    direction."""
+    shape = WorkloadShape(param_bytes=8e6, tp_param_bytes=8e6,
+                          global_batch=32, seq_len=16, d_model=64,
+                          n_layers=4)
+    cfg2 = MeshConfig(pp=2)
+    flat = predict_comm_bytes(cfg2, shape, 8)
+    assert flat["pp_bubble_fraction"] == 0.0  # no meta: flat terms
+    g = predict_comm_bytes(cfg2, shape, 8, schedule_meta={
+        "schedule": "gpipe", "virtual_stages": 1, "n_micro": 4})
+    f = predict_comm_bytes(cfg2, shape, 8, schedule_meta={
+        "schedule": "1f1b", "virtual_stages": 1, "n_micro": 4})
+    i2 = predict_comm_bytes(cfg2, shape, 8, schedule_meta={
+        "schedule": "interleaved", "virtual_stages": 2, "n_micro": 4})
+    # gpipe's term = flat bytes grown by exactly the bubble factor.
+    assert g["pp_bubble_fraction"] == pytest.approx(1 / 5)
+    assert g["pp_send_recv"] == pytest.approx(
+        flat["pp_send_recv"] * (1 + 1 / 5))
+    # Same bytes/bubble for 1f1b; MORE launches (M+2S-2 vs M+S-1).
+    assert f["pp_send_recv"] == pytest.approx(g["pp_send_recv"])
+    assert f["collective_ops"] > g["collective_ops"]
+    # Interleaved: V x boundary bytes, smaller bubble, most launches.
+    assert i2["pp_bubble_fraction"] == pytest.approx(1 / 9)
+    assert i2["pp_send_recv"] == pytest.approx(
+        flat["pp_send_recv"] * 2 * (1 + 1 / 9))
+    assert i2["collective_ops"] > f["collective_ops"]
+    # The pp op counts are the tick counts, one launch per
+    # direction (on top of the mesh's one dp grad-reduce launch).
+    assert g["collective_ops"] == 1 + 2 * pp_schedule_ticks(
+        "gpipe", 2, 4)
+    assert i2["collective_ops"] == 1 + 2 * pp_schedule_ticks(
+        "interleaved", 2, 4, 2)
+
+
+def test_pp_schedule_metas_legality():
+    from sparktorch_tpu.models import tiny_transformer
+
+    cfg = tiny_transformer(n_layers=4, max_len=16)
+    sizes = {"dp": 4, "fsdp": 1, "tp": 1, "sp": 1, "ep": 1, "pp": 2}
+    metas = pp_schedule_metas(sizes, cfg, global_batch=32)
+    # gpipe + 1f1b at the deterministic M (largest <= max(2S,4)=4
+    # dividing per-shard rows 8), plus interleaved V=2 (4 layers / 2
+    # stages): M must be a multiple of S there.
+    assert {m["schedule"] for m in metas} == {"gpipe", "1f1b",
+                                              "interleaved"}
+    for m in metas:
+        assert m["n_micro"] == 4
+        assert (32 // sizes["dp"]) % m["n_micro"] == 0
+        if m["schedule"] == "interleaved":
+            assert m["virtual_stages"] == 2
+            assert cfg.n_layers % (2 * m["virtual_stages"]) == 0
+            assert m["n_micro"] % sizes["pp"] == 0
+    # 2 layers cannot interleave over pp=2 (n_layers % (S*V) != 0).
+    cfg2 = tiny_transformer(n_layers=2, max_len=16)
+    metas2 = pp_schedule_metas(sizes, cfg2, global_batch=32)
+    assert {m["schedule"] for m in metas2} == {"gpipe", "1f1b"}
+    # max_virtual < 2 disables interleaving entirely.
+    metas_nov = pp_schedule_metas(sizes, cfg, 32, max_virtual=1)
+    assert {m["schedule"] for m in metas_nov} == {"gpipe", "1f1b"}
+    # Trainer-mirroring refusals: MoE x tp, sp without ring
+    # attention, ep without experts, non-transformer specs.
+    moe = tiny_transformer(n_layers=4, n_experts=4, moe_every=2)
+    assert pp_schedule_metas({**sizes, "tp": 2, "dp": 2}, moe, 32) == []
+    assert pp_schedule_metas({**sizes, "sp": 2, "dp": 2}, cfg, 32) == []
+    assert pp_schedule_metas({**sizes, "ep": 2, "dp": 2}, cfg, 32) == []
+    assert pp_schedule_metas(sizes, None, 32) == []
+    # MoE with a uniform per-stage pattern IS legal (pattern
+    # [dense, moe] x 2 over pp=2), and stays so for interleaved only
+    # if every CHUNK repeats it (4 layers / (2*2) = 1-layer chunks
+    # alternate dense/moe -> interleaved refused).
+    metas_moe = pp_schedule_metas(sizes, moe, 32)
+    assert {m["schedule"] for m in metas_moe} == {"gpipe", "1f1b"}
+
+
+def test_autotune_expands_pp_schedules_and_keeps_pure_dp():
+    """With the default axes the search space fans pp>1 meshes into
+    per-schedule candidates (labels carry the schedule), pure dp is
+    still always candidate material, and a scripted pp winner lands
+    in best/best_schedule and round-trips through the artifact."""
+    import tempfile
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    cfg = tiny_transformer(vocab_size=64, d_model=32, n_heads=2,
+                           n_layers=2, d_ff=64, max_len=8)
+    spec = ModelSpec(module=SequenceClassifier(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3})
+    batch = DataBatch(x=np.zeros((16, 8), np.int32),
+                      y=np.zeros((16,), np.int32),
+                      w=np.ones((16,), np.float32))
+    devices = list(range(8))
+
+    def scripted(spec_, config, batch_, devices_, tx=None,
+                 seq_sharded=False, telemetry=None, schedule_meta=None):
+        label = candidate_label(config.resolve(len(devices_)),
+                                schedule_meta)
+        wall = 0.005 if label == "dp4xpp2-gpipe_m4" else 0.030
+
+        def runner(steps):
+            return {"walls": [wall] * max(steps, 1),
+                    "comm_fraction": 0.2, "overlap_fraction": 0.1,
+                    "exposed_comm_fraction": 0.1,
+                    "n_collective_events": steps, "counts": {},
+                    "loss": 0.0}
+
+        runner.compile_s = 0.1
+        return runner
+
+    with tempfile.TemporaryDirectory() as td:
+        artifact = os.path.join(td, "tune_result.json")
+        result = autotune(spec, batch, devices, steps=2, repeats=2,
+                          min_rounds=1, measure_top_k=32,
+                          measure_fn=scripted, alpha_bytes=1 << 20,
+                          artifact_path=artifact)
+        loaded = TuneResult.load(artifact)
+    labels = [c.label for c in result.candidates]
+    # Pure dp is present, and the pp meshes fan out per schedule.
+    assert "dp8" in labels
+    assert "dp4xpp2-gpipe_m4" in labels
+    assert "dp4xpp2-1f1b_m4" in labels
+    # n_layers=2 cannot interleave over pp=2.
+    assert not any("int" in l for l in labels)
+    # Every pp candidate carries legal schedule meta (divisibility).
+    for c in result.candidates:
+        if c.axes.get("pp", 1) > 1:
+            assert c.schedule is not None
+            assert c.axes["fsdp"] == 1
+            per_shard = 16 // c.axes["dp"]
+            assert per_shard % c.schedule["n_micro"] == 0
+        else:
+            assert c.schedule is None
+    # The scripted winner is the pp2 gpipe candidate, schedule
+    # stamped on the result and preserved by the artifact round-trip.
+    assert result.best_label == "dp4xpp2-gpipe_m4"
+    assert result.best == {"dp": 4, "fsdp": 1, "tp": 1, "sp": 1,
+                           "ep": 1, "pp": 2}
+    assert result.best_schedule == {"schedule": "gpipe",
+                                    "virtual_stages": 1, "n_micro": 4}
+    assert loaded.best_schedule == result.best_schedule
+    assert loaded.best_label == result.best_label
+    for c, lc in zip(result.candidates, loaded.candidates):
+        assert lc.schedule == c.schedule
+
+
+def test_tune_cache_key_schema_fences_pre_pp_entries(monkeypatch,
+                                                     tmp_path):
+    """An entry cached by the pre-schedule tuner (schema 2, pp locked
+    to 1) must never satisfy the opened search: replicate the OLD key
+    doc for the same workload, store a result under it, and verify
+    autotune's cache lookup misses (the schema bump changed the
+    key)."""
+    import hashlib
+
+    from sparktorch_tpu.parallel.tune import (
+        TUNE_CACHE_ENV,
+        _cache_load,
+        _cache_store,
+        device_fingerprint,
+        tune_cache_key,
+        workload_for,
+    )
+    import dataclasses as _dc
+
+    monkeypatch.setenv(TUNE_CACHE_ENV, str(tmp_path))
+    spec, batch = _fake_spec_and_batch()
+    shape, cfg = workload_for(spec, batch)
+    caps = dict(transformer_caps(cfg, shape.seq_len))
+    caps["sp"] = (1,)
+    devices = list(range(8))
+    from sparktorch_tpu.parallel.tune import DEFAULT_AXES
+
+    # The OLD (schema 2) key for the same search inputs.
+    old_doc = {
+        "schema": 2,
+        "moe_dispatch": "shard_map_a2a",
+        "shape": _dc.asdict(shape),
+        "caps": {k: sorted(int(x) for x in v) for k, v in caps.items()},
+        "axes": list(DEFAULT_AXES),
+        "device": device_fingerprint(devices),
+        "seq_sharded": False,
+        "measure_top_k": 4,
+        "exposed_weight": 0.25,
+        "max_candidates": 64,
+        "measure": [4, 3, 2, 2.0],
+        "tx": None,
+        "alpha_override": None,
+    }
+    old_key = hashlib.sha256(
+        json.dumps(old_doc, sort_keys=True).encode()).hexdigest()[:24]
+    new_key = tune_cache_key(shape, caps, DEFAULT_AXES, devices,
+                             seq_sharded=False, measure_top_k=4,
+                             exposed_weight=0.25)
+    assert new_key != old_key
+    stale = TuneResult(
+        n_devices=8, global_batch=32, best={"dp": 8}, candidates=[],
+        noise_floor_s=0.0, early_stopped=False, steps_per_candidate=1,
+        wall_s=1.0, exposed_weight=0.25,
+    )
+    _cache_store(old_key, stale)
+    # The fenced entry exists on disk but the new key cannot load it.
+    assert _cache_load(old_key) is not None
+    assert _cache_load(new_key) is None
+
+
+def test_mesh_auto_pp_winner_builds_pipeline_step_loss_parity(tmp_path):
+    """mesh='auto' with a pp=2 winner returns a PIPELINE-scheduled
+    step (the tentpole's acceptance): same schedule path as a
+    directly-constructed train_pipeline step, pinned by loss equality
+    over 3 steps from the same seed."""
+    import jax
+
+    from sparktorch_tpu.models import SequenceClassifier, tiny_transformer
+    from sparktorch_tpu.parallel.mesh import MeshConfig, build_mesh
+    from sparktorch_tpu.train.pipeline import (
+        PipelineState,
+        make_pp_train_step,
+        pipeline_params_from_flax,
+        place_pipeline_state,
+    )
+    from sparktorch_tpu.train.sharded import make_sharded_train_step
+    from sparktorch_tpu.utils.data import DataBatch
+    from sparktorch_tpu.utils.serde import ModelSpec
+
+    cfg = tiny_transformer(vocab_size=64, d_model=32, n_heads=2,
+                           n_layers=2, d_ff=64, max_len=8)
+    spec = ModelSpec(module=SequenceClassifier(cfg), loss="cross_entropy",
+                     optimizer="adam", optimizer_params={"lr": 1e-3})
+    rng = np.random.default_rng(0)
+    batch = DataBatch(x=rng.integers(0, 64, (16, 8)).astype(np.int32),
+                      y=rng.integers(0, 2, (16,)).astype(np.int32),
+                      w=np.ones((16,), np.float32))
+
+    def scripted(spec_, config, batch_, devices_, tx=None,
+                 seq_sharded=False, telemetry=None, schedule_meta=None):
+        label = candidate_label(config.resolve(len(devices_)),
+                                schedule_meta)
+        wall = 0.005 if label == "dp4xpp2-gpipe_m4" else 0.030
+
+        def runner(steps):
+            return {"walls": [wall] * max(steps, 1),
+                    "comm_fraction": 0.2, "overlap_fraction": 0.1,
+                    "exposed_comm_fraction": 0.1,
+                    "n_collective_events": steps, "counts": {},
+                    "loss": 0.0}
+
+        runner.compile_s = 0.1
+        return runner
+
+    run = make_sharded_train_step(
+        spec.make_module().apply, spec.loss_fn(), spec.make_optimizer(),
+        mesh="auto", spec=spec, sample_batch=batch,
+        tune_kwargs={"measure_fn": scripted, "alpha_bytes": 1 << 20,
+                     "measure_top_k": 32, "steps": 1, "repeats": 1,
+                     "min_rounds": 1},
+    )
+    assert run.tune_result.best_label == "dp4xpp2-gpipe_m4"
+    assert run.pipeline_schedule == {"schedule": "gpipe",
+                                     "virtual_stages": 1, "n_micro": 4}
+    assert isinstance(run.state, PipelineState)
+    assert dict(run.mesh.shape)["pp"] == 2
+
+    auto_losses = []
+    state = run.state
+    for _ in range(3):
+        state, loss = run(state, batch)
+        auto_losses.append(float(loss))
+
+    # The direct construction: identical seed, layout, schedule.
+    mesh = build_mesh(MeshConfig(dp=4, pp=2))
+    tx = spec.make_optimizer()
+    flax_params = dict(spec.init_params(
+        jax.random.key(0), sample_x=np.asarray(batch.x[:1])))["params"]
+    pparams = pipeline_params_from_flax(flax_params, cfg)
+    dstate = place_pipeline_state(pparams, tx, mesh)
+    dstep = make_pp_train_step(cfg, tx, mesh, n_micro=4,
+                               head="classifier", schedule="gpipe")
+    direct_losses = []
+    for _ in range(3):
+        dstate, dloss = dstep(dstate, batch)
+        direct_losses.append(float(dloss))
+
+    np.testing.assert_allclose(auto_losses, direct_losses,
+                               rtol=1e-6, atol=0)
+    # And the losses are real training signal, not NaN/frozen.
+    assert np.isfinite(auto_losses).all()
+    assert auto_losses[0] != auto_losses[-1]
