@@ -2925,6 +2925,12 @@ def bench_elastic_ctl(n_parts: int = 36, part_sleep_s: float = 0.4,
         collector.stop()
         exporter.stop()
         coord.stop()
+    # Post-stop reads below are the PR 10 contract: stop() SNAPSHOTS
+    # final native state before freeing it (the pre-snapshot version of
+    # this very bench segfaulted here — sparklint SPK501 now guards the
+    # class; these two reads are the documented exception).
+    coord_generation = coord.generation  # lint-obs: ok (snapshot property, frozen by stop())
+    coord_world_size = coord.world_size  # lint-obs: ok (snapshot property, frozen by stop())
 
     # -- gates ---------------------------------------------------------
     missing = [p for p in work if not completed(p)]
@@ -2954,15 +2960,15 @@ def bench_elastic_ctl(n_parts: int = 36, part_sleep_s: float = 0.4,
     untagged = [h for h in ctl.history if "generation" not in h]
     if untagged:
         raise AssertionError(f"events missing generation tags: {untagged}")
-    if not (coord.generation == ctl.generation == summary["generation"]
+    if not (coord_generation == ctl.generation == summary["generation"]
             >= 2):
         raise AssertionError(
-            f"generation disagreement: coordinator {coord.generation}, "
+            f"generation disagreement: coordinator {coord_generation}, "
             f"controller {ctl.generation}, summary "
             f"{summary['generation']} (want agreement, >= 2)")
-    if coord.world_size != 3:  # ranks 0, 2 and the joined 3
+    if coord_world_size != 3:  # ranks 0, 2 and the joined 3
         raise AssertionError(
-            f"coordinator world_size {coord.world_size} != 3 after "
+            f"coordinator world_size {coord_world_size} != 3 after "
             "shrink+grow")
     # Every transition visible in the collector's /gang answer.
     elastic_doc = gang_doc.get("elastic") or {}

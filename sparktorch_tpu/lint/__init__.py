@@ -1,0 +1,74 @@
+"""sparklint — the repo's AST-based static-analysis pass.
+
+Every rule encodes a bug class this codebase has actually shipped (see
+each rule's ``why``); the analyzer replaced the Makefile's grep
+stanzas as the tier-1 ``make lint`` prerequisite. CLI::
+
+    python -m sparktorch_tpu.lint [paths] [--json] [--rule ID ...]
+
+Suppression is per-line and shares the historical annotation the greps
+established: ``# lint-obs: ok (<why>)`` on the finding's line (or a
+pure-comment line directly above it).
+"""
+
+from sparktorch_tpu.lint.core import (  # noqa: F401
+    FileContext,
+    Finding,
+    ModuleIndex,
+    Rule,
+    lint_file,
+    run_lint,
+)
+from sparktorch_tpu.lint.rules_jax import (
+    CollectiveContextRule,
+    RetraceHazardRule,
+)
+from sparktorch_tpu.lint.rules_lifecycle import HandleLifecycleRule
+from sparktorch_tpu.lint.rules_locks import LockHoldRule
+from sparktorch_tpu.lint.rules_obs import (
+    BareSpanRule,
+    EventKindCollisionRule,
+    JsonDumpRule,
+    ObsPrintRule,
+    SpanContextMintRule,
+    UrllibScrapeRule,
+)
+from sparktorch_tpu.lint.rules_timing import TimingLedgerRule
+
+#: Registry, ordered by rule ID. Adding a rule = subclass
+#: :class:`~sparktorch_tpu.lint.core.Rule`, set id/slug/summary/why,
+#: implement run(), append here, and give it a true-positive +
+#: true-negative fixture pair in tests/fixtures/lint/.
+ALL_RULES = (
+    ObsPrintRule(),
+    BareSpanRule(),
+    JsonDumpRule(),
+    UrllibScrapeRule(),
+    SpanContextMintRule(),
+    EventKindCollisionRule(),
+    TimingLedgerRule(),
+    LockHoldRule(),
+    RetraceHazardRule(),
+    CollectiveContextRule(),
+    HandleLifecycleRule(),
+)
+
+
+def rules_by_selector(selectors):
+    """Resolve ``--rule`` selectors (rule IDs or slugs, case-
+    insensitive) against the registry; raises KeyError naming the
+    unknown selector."""
+    if not selectors:
+        return ALL_RULES
+    by_key = {}
+    for r in ALL_RULES:
+        by_key[r.id.lower()] = r
+        by_key[r.slug.lower()] = r
+    picked = []
+    for sel in selectors:
+        rule = by_key.get(sel.lower())
+        if rule is None:
+            raise KeyError(sel)
+        if rule not in picked:
+            picked.append(rule)
+    return tuple(picked)

@@ -1,0 +1,7 @@
+"""``python -m sparktorch_tpu.lint`` entry point."""
+
+import sys
+
+from sparktorch_tpu.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,350 @@
+"""JAX hazard rules (SPK401 retrace-hazard, SPK402 collective-context).
+
+SPK401 encodes the recompile-tax class PR 14 chased at runtime: a
+jitted callable invoked with Python scalars derived from values that
+vary per call (``len(...)``, ``range``/``enumerate`` loop indices)
+keys a fresh compile-cache entry per distinct value — whether the
+scalar is shape-affecting (must be static, retraces per value) or
+accidentally traced (silently weak-typed). Either way it is a per-call
+compile-key decision that must be explicit (``static_argnums`` or
+hashed into the traced batch). The second shape: a jitted function
+closing over a *mutable module global* — the traced value is baked at
+the first compile, so later mutation is silently ignored (or, with
+``static_argnums``-style hashing, retraces).
+
+SPK402 encodes PR 12's MoE root-cause (a): on jax 0.4.x the GSPMD
+partitioner silently drops layout constraints, so a collective whose
+literal ``axis_name`` is not bound by an enclosing ``shard_map``/
+``pmap`` scope is either a trace-time error waiting for a code path or
+— worse — a constraint the partitioner rewrites into token-replicating
+all-gathers. Collectives whose axis comes in as a *parameter* are the
+caller's obligation and are skipped (``ops.attention.ring_attention``'s
+contract); literal-axis collectives must be reachable, within the
+module, from a function handed to ``shard_map``/``shard_map_compat``/
+``pmap`` (or registered via ``.defvjp`` — a custom-VJP fwd/bwd runs
+wherever its primal runs).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sparktorch_tpu.lint.core import FileContext, Finding, Rule
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def _is_jit_call(ctx: FileContext, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.index.resolve(node.func) in _JIT_NAMES)
+
+
+def _static_decls(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """(static_argnums, static_argnames) declared on a jax.jit call,
+    as far as they are literal."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    nums.add(v.value)
+        elif kw.arg == "static_argnames":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+    return nums, names
+
+
+class RetraceHazardRule(Rule):
+    id = "SPK401"
+    slug = "retrace-hazard"
+    summary = "jitted call keyed on a per-call-varying Python scalar"
+    why = ("the PR 14 recompile-tax class: every distinct Python scalar "
+           "reaching a jit boundary is a compile-cache key decision; "
+           "len()/loop-index arguments make it silently per-call")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._varying_scalar_args(ctx)
+        yield from self._mutable_global_closures(ctx)
+
+    # -- jitted calls fed len(...) / loop indices -----------------------
+    def _varying_scalar_args(self, ctx: FileContext) -> Iterator[Finding]:
+        idx = ctx.index
+        # name -> (static_argnums, static_argnames) for `f = jax.jit(..)`
+        jitted: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for node in idx.assigns:
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _is_jit_call(ctx, node.value)):
+                jitted[node.targets[0].id] = _static_decls(node.value)
+        if not jitted:
+            return
+        # Integer-ish loop variables: `for i in range(...)` /
+        # `for i, x in enumerate(...)` — keyed by the For node that
+        # binds them, so a same-named parameter in another function is
+        # never mistaken for a loop index (an arg counts only when the
+        # call site is lexically inside the binding loop).
+        loop_vars: Dict[str, List[ast.AST]] = {}
+        for node in idx.fors:
+            it = node.iter
+            src = (idx.resolve(it.func)
+                   if isinstance(it, ast.Call) else None)
+            if src == "range":
+                if isinstance(node.target, ast.Name):
+                    loop_vars.setdefault(node.target.id, []).append(node)
+            elif src == "enumerate":
+                if (isinstance(node.target, ast.Tuple) and node.target.elts
+                        and isinstance(node.target.elts[0], ast.Name)):
+                    loop_vars.setdefault(
+                        node.target.elts[0].id, []).append(node)
+
+        def varying(arg: ast.AST) -> Optional[str]:
+            if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "len"):
+                return "len(...)"
+            if isinstance(arg, ast.Name) and arg.id in loop_vars:
+                binders = loop_vars[arg.id]
+                if any(p in binders for p in idx.parent_chain(arg)):
+                    return f"loop index `{arg.id}`"
+            return None
+
+        for node in idx.calls:
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in jitted):
+                continue
+            nums, names = jitted[node.func.id]
+            for i, arg in enumerate(node.args):
+                desc = varying(arg)
+                if desc and i not in nums:
+                    yield self.finding(
+                        ctx, arg,
+                        f"{desc} passed to jitted `{node.func.id}` at "
+                        f"position {i} without a static_argnums "
+                        f"declaration — a per-call-varying Python "
+                        f"scalar is a silent compile-cache key (PR 14 "
+                        f"recompile tax); declare it static or fold it "
+                        f"into the traced batch")
+            for kw in node.keywords:
+                desc = varying(kw.value) if kw.arg else None
+                if desc and kw.arg not in names:
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"{desc} passed to jitted `{node.func.id}` as "
+                        f"`{kw.arg}=` without a static_argnames "
+                        f"declaration — a per-call-varying Python "
+                        f"scalar is a silent compile-cache key (PR 14 "
+                        f"recompile tax)")
+
+    # -- jitted closures over mutable module globals --------------------
+    def _mutable_global_closures(self, ctx: FileContext) -> Iterator[Finding]:
+        idx = ctx.index
+        mutable: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                v = stmt.value
+                if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                    mutable.add(stmt.targets[0].id)
+                elif (isinstance(v, ast.Call)
+                        and idx.resolve(v.func) in ("dict", "list", "set")):
+                    mutable.add(stmt.targets[0].id)
+        if not mutable:
+            return
+        mutated: Set[str] = set()
+        _MUTATORS = {"append", "update", "pop", "clear", "extend",
+                     "setdefault", "add", "remove", "insert"}
+        for g in idx.globals_:
+            mutated.update(n for n in g.names if n in mutable)
+        for node in idx.subscripts:
+            if (isinstance(node.ctx, (ast.Store, ast.Del))
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in mutable):
+                mutated.add(node.value.id)
+        for node in idx.calls:
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in mutable):
+                mutated.add(node.func.value.id)
+        if not mutated:
+            return
+        for fn in self._jitted_defs(ctx):
+            local: Set[str] = {a.arg for a in fn.args.args
+                               + fn.args.posonlyargs + fn.args.kwonlyargs}
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Store)):
+                    local.add(node.id)
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mutated and node.id not in local):
+                    yield self.finding(
+                        ctx, node,
+                        f"jitted `{fn.name}` closes over mutable module "
+                        f"global `{node.id}` — the traced value is "
+                        f"baked at the first compile; later mutation "
+                        f"is silently ignored (PR 14 recompile-tax "
+                        f"class). Pass it as an argument instead")
+
+    def _jitted_defs(self, ctx: FileContext) -> Iterator[ast.FunctionDef]:
+        idx = ctx.index
+        defs: Dict[str, ast.FunctionDef] = {}
+        seen: Set[int] = set()
+        for node in idx.funcdefs:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        for node in idx.funcdefs:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if (idx.resolve(dec) in _JIT_NAMES
+                        or _is_jit_call(ctx, dec)):
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        yield node
+        # `g = jax.jit(f)` over a module-level def.
+        for node in idx.calls:
+            if (_is_jit_call(ctx, node) and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in defs):
+                fn = defs[node.args[0].id]
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    yield fn
+
+
+_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.all_gather", "jax.lax.all_to_all", "jax.lax.ppermute",
+    "jax.lax.psum_scatter", "jax.lax.axis_index",
+}
+# Position of the axis-name argument when passed positionally.
+_AXIS_POS = {name: (0 if name.endswith("axis_index") else 1)
+             for name in _COLLECTIVES}
+_WRAPPER_LAST = {"shard_map", "shard_map_compat", "pmap", "xmap"}
+
+
+class CollectiveContextRule(Rule):
+    id = "SPK402"
+    slug = "collective-context"
+    summary = "literal-axis collective outside any shard_map/pmap scope"
+    why = ("PR 12 MoE root-cause (a): the GSPMD partitioner silently "
+           "drops unapplied constraints and derives token-replicating "
+           "all-gathers; a literal axis_name must be bound by a "
+           "shard_map/pmap the module can show")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        idx = ctx.index
+        # Named callables: defs plus name-assigned lambdas.
+        named: Dict[str, List[ast.AST]] = {}
+        for node in idx.funcdefs:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                named.setdefault(node.name, []).append(node)
+        for node in idx.assigns:
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Lambda)):
+                named.setdefault(node.targets[0].id, []).append(node.value)
+
+        # Name loads per immediate scope, for the propagation pass.
+        loads_in_scope: Dict[int, Set[str]] = {}
+        for node in idx.names:
+            if isinstance(node.ctx, ast.Load):
+                scope = idx.scope_of.get(id(node))
+                loads_in_scope.setdefault(id(scope), set()).add(node.id)
+
+        bound: Set[int] = set()  # id() of bound function-ish nodes
+        pending: List[ast.AST] = []
+
+        def bind(fn_node: ast.AST) -> None:
+            if id(fn_node) not in bound:
+                bound.add(id(fn_node))
+                pending.append(fn_node)
+                # Lexically nested defs execute under the same mapped
+                # scope when called from it.
+                for child in idx.scope_children.get(id(fn_node), []):
+                    bind(child)
+
+        for node in idx.calls:
+            name = idx.resolve(node.func)
+            last = name.rsplit(".", 1)[-1] if name else (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else None)
+            if last in _WRAPPER_LAST and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Lambda):
+                    bind(arg0)
+                elif isinstance(arg0, ast.Name):
+                    for d in named.get(arg0.id, []):
+                        bind(d)
+            elif last == "defvjp":
+                # fwd/bwd run wherever their primal runs; the primal's
+                # own binding is checked on its own collectives.
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        for d in named.get(arg.id, []):
+                            bind(d)
+
+        # Propagate: a bound function binds every module function whose
+        # name its body references (call, pass-through, dict dispatch).
+        while pending:
+            fn = pending.pop()
+            for ref in loads_in_scope.get(id(fn), ()):
+                for d in named.get(ref, []):
+                    bind(d)
+
+        for node in idx.calls:
+            name = idx.resolve(node.func)
+            if name not in _COLLECTIVES:
+                continue
+            axis = self._axis_expr(node, name)
+            literal = self._literal_axis(ctx, axis)
+            if literal is None:
+                continue  # parameterized/unresolvable: caller's contract
+            if any(id(fn) in bound
+                   for fn in idx.enclosing_functions(node)):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`{name.rsplit('.', 1)[-1]}` over literal axis "
+                f"{literal!r} outside any shard_map/pmap-bound scope in "
+                f"this module — under GSPMD the partitioner silently "
+                f"drops the constraint and derives replicating "
+                f"collectives (PR 12 MoE root-cause); wrap the caller "
+                f"in shard_map or take the axis as a parameter")
+
+    @staticmethod
+    def _axis_expr(call: ast.Call, name: str) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        pos = _AXIS_POS[name]
+        if len(call.args) > pos:
+            return call.args[pos]
+        return None
+
+    def _literal_axis(self, ctx: FileContext,
+                      axis: Optional[ast.AST]) -> Optional[str]:
+        """The literal axis-name string (or tuple repr) when the
+        expression is a constant / module string constant / tuple of
+        those; None when parameterized or unresolvable."""
+        if axis is None:
+            return None
+        if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+            return axis.value
+        if isinstance(axis, ast.Name):
+            return ctx.index.str_consts.get(axis.id)
+        if isinstance(axis, (ast.Tuple, ast.List)):
+            parts = [self._literal_axis(ctx, e) for e in axis.elts]
+            if all(p is not None for p in parts):
+                return "(" + ", ".join(parts) + ")"  # type: ignore[arg-type]
+        return None

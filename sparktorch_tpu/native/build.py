@@ -25,7 +25,7 @@ def load_library(name: str) -> ctypes.CDLL:
             os.path.exists(src_path)
             and os.path.getmtime(src_path) > os.path.getmtime(so_path)
         ):
-            subprocess.run(
+            subprocess.run(  # lint-obs: ok (build serialization is the lock's purpose: one compiler run per process)
                 ["make", "-C", _NATIVE_DIR, f"build/lib{name}.so"],
                 check=True,
                 capture_output=True,

@@ -66,10 +66,15 @@ class JsonlSink:
         self._f: Optional[Any] = open(path, "a" if append else "w")
 
     def __call__(self, event: Dict[str, Any]) -> None:
+        # Serialize OUTSIDE the lock (sparklint SPK301): the lock is
+        # the file's writer lock — it buys line atomicity, not a
+        # json.dumps of an arbitrarily large event while every other
+        # emitter waits.
+        line = json.dumps(event) + "\n"
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(json.dumps(event) + "\n")
+            self._f.write(line)
             self._f.flush()
 
     def close(self) -> None:

@@ -273,7 +273,7 @@ class ParamShardServer:
                     f"param shard {self.shard_id} is stopped"
                 )
             self._queue.put((flat, done, trace_ctx,
-                             wall_ts(), time.perf_counter()))
+                             wall_ts(), time.perf_counter()))  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
         self.telemetry.counter("param_server.pushes", labels=self._labels)
         if wait and not done.wait(timeout):
             raise TimeoutError(
@@ -292,16 +292,23 @@ class ParamShardServer:
             except queue.Empty:
                 continue
             try:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter()  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                 tracer.record("queue_wait", tctx, enq_ts, t0 - enq_t0,
                               kind="server", shard=self.shard_id)
+                # Stage H2D transfers BEFORE taking the state lock
+                # (sparklint SPK301): pulls must not wait on device
+                # transfer. A leaf misrouted by a stale ring pays one
+                # wasted transfer — rare, counted, self-healing.
+                staged = {path: jax.device_put(np.asarray(grad),
+                                               self.device)
+                          for path, grad in flat.items()}
                 with tracer.child_span("apply", tctx, kind="server",
                                        shard=self.shard_id), \
                         self._state_lock:
                     _version, params, _vers = self.slot.read_leaves()
                     owned: Dict[str, Path] = {}
                     grads: Dict[str, Any] = {}
-                    for path, grad in flat.items():
+                    for path, dev_grad in staged.items():
                         if path not in params:
                             # A partial routed by a stale ring (leaf
                             # moved by add/drain): dropped + counted,
@@ -313,8 +320,7 @@ class ParamShardServer:
                             continue
                         key = "/".join(path)
                         owned[key] = path
-                        grads[key] = jax.device_put(np.asarray(grad),
-                                                    self.device)
+                        grads[key] = dev_grad
                     if owned:
                         new_params, new_opts = self._apply_fn(
                             {k: params[p] for k, p in owned.items()},
@@ -331,7 +337,7 @@ class ParamShardServer:
                         self.telemetry.counter("param_server.applies",
                                                labels=self._labels)
                 self.telemetry.observe("param_server.apply_s",
-                                       time.perf_counter() - t0,
+                                       time.perf_counter() - t0,  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                                        labels=self._labels)
                 self.telemetry.gauge("param_server.version",
                                      self.slot.version, labels=self._labels)
@@ -432,16 +438,21 @@ class ParamShardServer:
         delta client picks them up on its next pull."""
         if not entries:
             return
+        # Stage device transfers OUTSIDE the state lock (sparklint
+        # SPK301): entries are the caller's migration payload, so only
+        # the _opt/slot swap needs pull-consistency.
+        staged = []
+        for path, entry in entries.items():
+            path = tuple(path)
+            param = jax.device_put(entry["param"], self.device)
+            opt = entry.get("opt")
+            opt_state = (jax.device_put(opt, self.device)
+                         if opt is not None else self._tx.init(param))
+            staged.append((path, param, opt_state))
         with self._state_lock:
             new_leaves: Dict[Path, Any] = {}
-            for path, entry in entries.items():
-                path = tuple(path)
-                param = jax.device_put(entry["param"], self.device)
-                opt = entry.get("opt")
-                self._opt[path] = (
-                    jax.device_put(opt, self.device)
-                    if opt is not None else self._tx.init(param)
-                )
+            for path, param, opt_state in staged:
+                self._opt[path] = opt_state
                 new_leaves[path] = param
             self.slot.swap_leaves(new_leaves)
 

@@ -177,7 +177,7 @@ class ParameterServer:
             raise RuntimeError("parameter server failed") from self._failed
         done = threading.Event() if wait else None
         self._queue.put((grads, done, trace_ctx,
-                         wall_ts(), time.perf_counter()))
+                         wall_ts(), time.perf_counter()))  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
         self.telemetry.counter("param_server.pushes")
         self.telemetry.gauge("param_server.queue_depth", self._queue.qsize())
         if done is not None and not done.wait(timeout):
@@ -192,7 +192,7 @@ class ParameterServer:
             except queue.Empty:
                 continue
             try:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter()  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                 # Queue-wait attribution: enqueue happened on a handler
                 # thread, the pop here — the after-the-fact record is
                 # the only honest way to span it.
@@ -203,7 +203,7 @@ class ParameterServer:
                 # into the ambient goodput ledger's compute bucket
                 # (no-op when no ledger is installed on this rank).
                 with tracer.child_span("apply", tctx, kind="server"), \
-                        _goodput.span("compute", {"site": "ps_apply"}):  # lint-obs: ok (wrapped with-block continuation)
+                        _goodput.span("compute", {"site": "ps_apply"}):
                     version, params = self.slot.read()
                     grads = jax.device_put(grads, self.device)
                     new_params, new_opt = self._apply_fn(
@@ -214,7 +214,7 @@ class ParameterServer:
                 self._applied += 1
                 self.telemetry.counter("param_server.applies")
                 self.telemetry.observe("param_server.apply_s",
-                                       time.perf_counter() - t0)
+                                       time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                 self.telemetry.gauge("param_server.version", version + 1)
             except Exception as e:  # tolerate a bounded error count
                 self._errors += 1
@@ -547,7 +547,7 @@ class ParamServerHttp:
                 if route == "/delta.bin" \
                         and hasattr(ps, "render_delta"):
                     with self._serve_span(route, self._trace_ctx()) as ssp:
-                        t0 = time.perf_counter()
+                        t0 = time.perf_counter()  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         have = int(self.headers.get("X-Have-Version",
                                                     "-1"))
                         quant = self.headers.get("X-Pull-Quant") or None
@@ -565,7 +565,7 @@ class ParamServerHttp:
                         if body is None:
                             self._send(304, extra_headers=hdrs)
                             _record_wire(route, "tx", 0,
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                             return
                         act = _chaos.fire("param_server.pull",
                                           route=route)
@@ -575,7 +575,7 @@ class ParamServerHttp:
                                    content_type=binwire.CONTENT_TYPE,
                                    extra_headers=hdrs)
                         _record_wire(route, "tx", len(body),
-                                     time.perf_counter() - t0)
+                                     time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                     return
                 if route in extra_json:
                     try:
@@ -590,7 +590,7 @@ class ParamServerHttp:
                     self._send(200, b"sparktorch-tpu parameter server")
                 elif route in ("/parameters", "/parameters.bin"):
                     with self._serve_span(route, self._trace_ctx()) as ssp:
-                        t0 = time.perf_counter()
+                        t0 = time.perf_counter()  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         have = int(self.headers.get("X-Have-Version",
                                                     "-1"))
                         binary = route.endswith(".bin")
@@ -605,7 +605,7 @@ class ParamServerHttp:
                             # byte-compatible.
                             self._send(304 if binary else 204)
                             _record_wire(route, "tx", 0,
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         else:
                             act = _chaos.fire("param_server.pull",
                                               route=route)
@@ -620,7 +620,7 @@ class ParamServerHttp:
                                        content_type=binwire.CONTENT_TYPE
                                        if binary else None)
                             _record_wire(route, "tx", len(body),
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                 elif route == "/metrics":
                     text = render_prometheus(ps.telemetry.snapshot())
                     self._send(200, text.encode(),
@@ -645,7 +645,7 @@ class ParamServerHttp:
                 raw = self.rfile.read(length)
                 if route == "/update":
                     with self._serve_span(route, self._trace_ctx()) as ssp:
-                        t0 = time.perf_counter()
+                        t0 = time.perf_counter()  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         try:
                             # Chaos 500s fire here — inside the try, so
                             # the forced error takes the same path a
@@ -659,14 +659,14 @@ class ParamServerHttp:
                             ps.push_gradients(grads, trace_ctx=ssp.ctx)
                             self._send(200, b"OK")
                             _record_wire(route, "rx", len(raw),
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         except Exception:
                             ssp.annotate(http_status=500)
                             self._send(500)
                 elif route == "/update.bin":
                     with self._serve_span(route,
                                           self._trace_ctx(raw)) as ssp:
-                        t0 = time.perf_counter()
+                        t0 = time.perf_counter()  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         try:
                             with tracer.child_span("decode", ssp.ctx,
                                                    kind="server"):
@@ -691,7 +691,7 @@ class ParamServerHttp:
                             ps.push_gradients(grads, trace_ctx=ssp.ctx)
                             self._send(200, b"OK")
                             _record_wire(route, "rx", len(raw),
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0)  # lint-obs: ok (request-latency histogram clock pair, not a ledger region)
                         except Exception:
                             ssp.annotate(http_status=500)
                             self._send(500)

@@ -807,7 +807,7 @@ def train_distributed_streaming(
                 cache0 = (_goodput.jit_cache_size(step_fn)
                           if _goodput.active() is not None else None)
                 with _goodput.step_span() as _led, \
-                        tele.span("train_streaming/chunk"):  # lint-obs: ok (wrapped with-block continuation)
+                        tele.span("train_streaming/chunk"):
                     state, metrics = step_fn(state, resident)
                     # Enqueue the NEXT chunk's host->device copy while
                     # the current chunk's (already dispatched) steps

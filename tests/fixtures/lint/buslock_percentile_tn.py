@@ -1,0 +1,25 @@
+"""SPK301 true negative — the fixed idiom: snapshot cheap state under
+the lock, compute the percentile outside it."""
+
+import threading
+
+import numpy as np
+
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+
+    def observe(self, v):
+        with self._lock:
+            self._samples.append(v)
+
+    def rollup(self):
+        with self._lock:
+            count = len(self._samples)
+            samples = tuple(self._samples)
+        return {
+            "count": count,
+            "p99": float(np.percentile(samples, 99.0)),
+        }

@@ -1,0 +1,25 @@
+"""SPK301 true positive — the PR 9/11 shipped regression, minimally:
+a telemetry-bus-shaped class computing percentile roll-ups while
+holding the bus lock, serializing every counter bump on every thread
+behind an O(4096) numpy call."""
+
+import threading
+
+import numpy as np
+
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+
+    def observe(self, v):
+        with self._lock:
+            self._samples.append(v)
+
+    def rollup(self):
+        with self._lock:
+            return {
+                "count": len(self._samples),
+                "p99": float(np.percentile(self._samples, 99.0)),
+            }

@@ -1,0 +1,43 @@
+"""SPK402 true negatives — every sanctioned binding shape: a function
+handed to shard_map (through the repo's shard_map_compat), a helper
+reached from it, a custom-VJP fwd/bwd pair bound via defvjp, and a
+collective whose axis is a parameter (the caller's obligation)."""
+
+import jax
+
+from sparktorch_tpu.train.step import shard_map_compat
+
+AXIS_DP = "dp"
+
+
+def _reduce_helper(x):
+    return jax.lax.psum(x, AXIS_DP)
+
+
+def _body(x):
+    return _reduce_helper(x) + jax.lax.axis_index(AXIS_DP)
+
+
+def _body_fwd(x):
+    return _body(x), None
+
+
+def _body_bwd(_, ct):
+    return (jax.lax.psum(ct, AXIS_DP),)
+
+
+def make_step(mesh, in_specs, out_specs):
+    return shard_map_compat(_body, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs)
+
+
+def ring_shift(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+class _Stub:
+    def defvjp(self, *fns):
+        return fns
+
+
+_Stub().defvjp(_body_fwd, _body_bwd)

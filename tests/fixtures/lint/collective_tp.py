@@ -1,0 +1,16 @@
+"""SPK402 true positive — the PR 12 MoE root-cause shape: a literal-
+axis collective in a module that never binds the axis with a
+shard_map/pmap (on jax 0.4.x GSPMD the partitioner silently drops the
+constraint and derives token-replicating all-gathers)."""
+
+import jax
+
+AXIS_EP = "ep"
+
+
+def dispatch(tokens):
+    return jax.lax.all_to_all(tokens, AXIS_EP, 0, 1, tiled=True)
+
+
+def combine(tokens):
+    return jax.lax.psum(tokens, axis_name="ep")

@@ -1,0 +1,8 @@
+"""SPK106 true negative — the fixed idiom: prefixed payload keys
+(`rule_kind`, like obs/alerts.py ships) never collide with the sink
+record envelope."""
+
+
+def fire(tele, rule_name):
+    tele.event("alert.fired", rule=rule_name,
+               rule_kind="threshold", fired_ts=0.0, source_rank=3)

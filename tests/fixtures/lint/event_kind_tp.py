@@ -1,0 +1,10 @@
+"""SPK106 true positive — the shipped `Telemetry.event(kind=...)`
+collision (the alerts WATCH): reserved envelope keys passed as payload
+fields silently overwrite the sink record's own ts/kind/rank."""
+
+
+def fire(tele, rule_name):
+    tele.event("alert.fired", rule=rule_name,
+               kind="threshold",  # collides with the record kind
+               ts=0.0,            # collides with the record stamp
+               rank=3)            # collides with the collector tag

@@ -1,0 +1,31 @@
+"""SPK101-105 true negatives — the sanctioned idioms: logger, span as
+a with-block (and via ExitStack.enter_context), json.dumps of a
+non-telemetry payload, collector scrape helpers, tracer-helper span
+minting."""
+
+import contextlib
+import json
+
+from sparktorch_tpu.obs.collector import scrape_json
+from sparktorch_tpu.obs.log import get_logger
+from sparktorch_tpu.obs.rpctrace import root_span
+
+log = get_logger("fixture")
+
+
+def report(tele, results):
+    log.info("training done: %s", results)
+    with tele.span("train/step"):
+        pass
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(tele.span("train/flush"))
+    return json.dumps(results)
+
+
+def scrape(url):
+    return scrape_json(url, timeout=1.0)
+
+
+def mint(tracer):
+    ctx = root_span(tracer)
+    return ctx.child()

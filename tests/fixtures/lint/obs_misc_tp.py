@@ -1,0 +1,26 @@
+"""SPK101-105 true positives — one per migrated grep rule: raw print,
+bare span, raw json.dump, ad-hoc urllib scraping, span-context
+minting. The bare span and the urlopen are split across lines, which
+the greps could not see through."""
+
+import json
+import urllib.request
+
+from sparktorch_tpu.obs.rpctrace import SpanContext
+
+
+def report(tele, results, path):
+    print("training done:", results)
+    tele.span(
+        "train/step")
+    with open(path, "w") as f:
+        json.dump(results, f)
+
+
+def scrape(url):
+    return urllib.request.urlopen(
+        url, timeout=1.0).read()
+
+
+def mint():
+    return SpanContext(trace_id=1, span_id=2, sampled=True)

@@ -1,0 +1,19 @@
+"""SPK401 true negatives — declared static scalars and state passed
+as arguments instead of closed-over mutable globals."""
+
+import jax
+
+_PEAK_FLOPS = 197e12
+
+
+@jax.jit
+def scaled_loss(x, scale):
+    return x * scale * (1.0 / _PEAK_FLOPS)
+
+
+def train(step_fn, batches):
+    step = jax.jit(step_fn, static_argnums=(1,))
+    out = None
+    for i, batch in enumerate(batches):
+        out = step(batch, i)
+    return step(out, len(batches))

@@ -1,0 +1,24 @@
+"""SPK401 true positives — the PR 14 recompile-tax class: a jitted
+callable fed len()/loop-index Python scalars with no static
+declaration, and a jitted closure over a mutated module global."""
+
+import jax
+
+_RUNTIME_FLAGS = {"scale": 1.0}
+
+
+def configure(scale):
+    _RUNTIME_FLAGS["scale"] = scale
+
+
+@jax.jit
+def scaled_loss(x):
+    return x * _RUNTIME_FLAGS["scale"]
+
+
+def train(step_fn, batches):
+    step = jax.jit(step_fn)
+    out = None
+    for i in range(len(batches)):
+        out = step(batches[i], i)
+    return step(out, len(batches))

@@ -1,0 +1,30 @@
+"""SPK501 true negatives — the fixed idiom (snapshot before stop),
+the supervisor contract (error/is_alive/join stay valid after kill),
+and reassignment clearing the stopped state."""
+
+from sparktorch_tpu.ctl.proc import ProcessWorker
+from sparktorch_tpu.native.gang import GangCoordinator
+
+
+def run_gang(n):
+    coord = GangCoordinator(world_size=n)
+    try:
+        coord.barrier()
+        generation = coord.generation
+    finally:
+        coord.stop()
+    return generation
+
+
+def preempt(fn):
+    worker = ProcessWorker(fn)
+    worker.kill()
+    worker.join()
+    return worker.error, worker.is_alive()
+
+
+def restart(fn):
+    worker = ProcessWorker(fn)
+    worker.kill()
+    worker = ProcessWorker(fn)
+    return worker.heartbeat_age

@@ -1,0 +1,19 @@
+"""Suppression fixture — real violations carrying the documented
+`# lint-obs: ok (<why>)` annotation, on the finding's line and on a
+pure-comment line directly above it. The analyzer must report
+nothing."""
+
+import time
+
+
+def stamp():
+    return time.time()  # lint-obs: ok (fixture: documented exception)
+
+
+def stamp_above():
+    # lint-obs: ok (fixture: annotation on the preceding comment line)
+    return time.time()
+
+
+def report(results):
+    print("done:", results)  # lint-obs: ok (fixture: CLI-style output)

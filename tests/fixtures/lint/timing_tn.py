@@ -1,0 +1,16 @@
+"""SPK201 true negatives — the sanctioned idioms: wall_ts() for
+timestamps, a goodput LedgerSpan (+ .duration_s) for measured
+regions."""
+
+from sparktorch_tpu.obs import goodput
+from sparktorch_tpu.obs.telemetry import wall_ts
+
+
+def stamp_event(tele):
+    tele.event("worker.started", started=wall_ts())
+
+
+def measure_step(step, batch):
+    with goodput.span("compute", {"site": "fixture"}) as sp:
+        step(batch)
+    return sp.duration_s

@@ -1,0 +1,15 @@
+"""SPK201 true positives — raw clocks, including the aliased imports
+the historical grep ban could never see."""
+
+import time
+from time import perf_counter as pc
+
+
+def stamp_event(tele):
+    tele.event("worker.started", started=time.time())
+
+
+def measure_step(step, batch):
+    t0 = pc()
+    step(batch)
+    return pc() - t0
