@@ -8,7 +8,8 @@ PYTHON ?= python
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
 	bench-ps-fleet bench-tune bench-pp-tune bench-rpc-trace \
 	bench-serve bench-elastic bench-obs-history bench-moe \
-	bench-goodput bench-lint cluster-up clean lint lint-obs
+	bench-goodput bench-profile bench-lint cluster-up clean lint \
+	lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -259,6 +260,21 @@ bench-obs-history:
 bench-goodput:
 	$(PYTHON) -m sparktorch_tpu.bench --config goodput \
 		--log benchmarks/bench_r11_goodput.jsonl
+
+# Continuous stack-profiler gate: the sampler must cost < 1% of the
+# measured step wall vs an A/A profiler-off leg (min of interleaved
+# runs), a planted busy-loop inside a compute LedgerSpan must surface
+# as the top self-time frame of its bucket (>= 80% of the bucket's
+# samples), and two ranks' sections must merge into `GET /profile`
+# with `timeline --profile` rendering the planted frame from both a
+# saved document and the collector sink — FAILS otherwise. The record
+# is retained (--log) so the per-tick sample-cost drift gate arms
+# against the windowed median of prior rounds
+# (SPARKTORCH_TPU_PROFILE_DRIFT_TOL, relative, default 1.0). Runs on
+# any backend (JAX_PLATFORMS=cpu works).
+bench-profile:
+	$(PYTHON) -m sparktorch_tpu.bench --config profile \
+		--log benchmarks/bench_r14_profile.jsonl
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

@@ -3849,6 +3849,225 @@ def bench_goodput(n_parts: int = 10, part_sleep_s: float = 0.25,
     }
 
 
+def _profile_hot_planted(stop_t: float) -> float:
+    """The seeded hot function bench_profile plants inside a compute
+    LedgerSpan: pure-Python arithmetic (no genexpr, no callees), so
+    every sample of it lands as SELF time on this very frame — the
+    profiler must name it or the attribution chain is broken."""
+    acc = 0.0
+    while time.perf_counter() < stop_t:
+        for i in range(2000):
+            acc += i * i
+    return acc
+
+
+def bench_profile(n_steps: int = 30, reps: int = 3,
+                  hot_s: float = 1.2) -> dict:
+    """Continuous stack-profiler gate (``make bench-profile``) — FAILS
+    (raises) unless the sampler's three claims hold end to end:
+
+    - **it is nearly free**: with the sampler running at its default
+      rate, the measured training-step wall grows by < 1% vs an A/A
+      profiler-off leg (min of interleaved runs, the PR 11 lesson:
+      medians swing with scheduler noise), and the per-tick sample
+      cost is drift-gated against the windowed median of prior rounds
+      (``SPARKTORCH_TPU_PROFILE_DRIFT_TOL``);
+    - **attribution is real**: a planted busy-loop inside a
+      ``compute`` LedgerSpan surfaces as the top self-time frame of
+      the compute bucket with >= 80% of that bucket's samples;
+    - **the fleet path works**: two ranks' published sections merge
+      into ``GET /profile`` over real HTTP, and
+      ``timeline --profile`` renders the planted frame from both a
+      saved /profile document and the collector's JSONL sink.
+    """
+    import contextlib
+    import io
+    import os
+    import tempfile
+    import threading
+
+    import jax
+
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+    from sparktorch_tpu.obs import FleetCollector, Telemetry
+    from sparktorch_tpu.obs import goodput as _goodput
+    from sparktorch_tpu.obs import timeline as _timeline
+    from sparktorch_tpu.obs.collector import scrape_json
+    from sparktorch_tpu.obs.profile import StackProfiler, top_frames
+
+    t_start = time.perf_counter()
+    workdir = tempfile.mkdtemp(prefix="bench_profile_")
+
+    # -- leg 1: A/A overhead (profiler off vs on, interleaved) ---------
+    m = 768
+    step = jax.jit(lambda a: a @ a)
+    xm = np.ones((m, m), np.float32)
+    step(xm).block_until_ready()  # compile outside both arms
+    tick_costs_us: List[float] = []
+
+    def _arm(profiler_on: bool) -> float:
+        prof = StackProfiler() if profiler_on else None
+        if prof is not None:
+            prof.start()
+        walls = []
+        try:
+            for _ in range(n_steps):
+                t0 = time.perf_counter()
+                step(xm).block_until_ready()
+                walls.append(time.perf_counter() - t0)
+        finally:
+            if prof is not None:
+                doc = prof.stop()
+                if doc["ticks"] <= 0:
+                    raise AssertionError(
+                        "profiler-on arm took no sample ticks")
+                tick_costs_us.append(float(doc["sample_tick_us"]))
+        return min(walls)
+
+    offs, ons = [], []
+    for _ in range(reps):
+        offs.append(_arm(False))
+        ons.append(_arm(True))
+    w_off, w_on = min(offs), min(ons)
+    overhead_frac = max(w_on - w_off, 0.0) / max(w_off, 1e-9)
+    if overhead_frac >= 0.01:
+        raise AssertionError(
+            f"sampler overhead is {100 * overhead_frac:.2f}% of the "
+            f"{w_off * 1e3:.3f}ms step wall (bound: 1%; on "
+            f"{w_on * 1e3:.3f}ms vs off {w_off * 1e3:.3f}ms, min of "
+            f"{reps} interleaved runs)")
+    sample_tick_us = min(tick_costs_us)
+
+    # -- leg 2: planted hot function owns its bucket -------------------
+    tele0 = Telemetry(run_id="bench_profile_r0")
+    prof0 = StackProfiler(telemetry=tele0, rank=0, hz=250.0,
+                          publish_interval_s=0.2)
+    prof0.start()
+    try:
+        with _goodput.span("compute"):
+            _profile_hot_planted(time.perf_counter() + hot_s)
+    finally:
+        doc0 = prof0.stop()
+    buckets0 = doc0.get("buckets") or {}
+    if "compute" not in buckets0:
+        raise AssertionError(
+            f"no compute bucket sampled: {sorted(buckets0)}")
+    frames = top_frames(doc0, "compute", n=3)
+    if not frames or not frames[0][0].startswith("_profile_hot_planted"):
+        raise AssertionError(
+            f"planted hot function is not the compute bucket's top "
+            f"self-time frame: {frames}")
+    bucket_samples = int(buckets0["compute"].get("samples") or 0)
+    hot_share = frames[0][1] / max(bucket_samples, 1)
+    if hot_share < 0.8:
+        raise AssertionError(
+            f"planted function holds only {100 * hot_share:.1f}% of "
+            f"the compute bucket's {bucket_samples} samples "
+            f"(want >= 80%)")
+
+    # -- leg 3: 2-rank merge over HTTP + timeline renders --------------
+    tele1 = Telemetry(run_id="bench_profile_r1")
+    prof1 = StackProfiler(telemetry=tele1, rank=1, hz=250.0,
+                          publish_interval_s=0.2)
+    release = threading.Event()
+
+    def _rank1_waits():
+        with _goodput.span("data_wait", {"site": "bench"}):
+            release.wait(timeout=10.0)
+
+    waiter = threading.Thread(target=_rank1_waits, daemon=True)
+    waiter.start()
+    prof1.start()
+    time.sleep(0.3)
+    release.set()
+    waiter.join(timeout=5.0)
+    prof1.stop()
+
+    exp0 = GangMetricsExporter(telemetry=tele0, port=0).start()
+    exp1 = GangMetricsExporter(telemetry=tele1, port=0).start()
+    sink = os.path.join(workdir, "collector_sink.jsonl")
+    collector = FleetCollector({0: exp0.url, 1: exp1.url},
+                               poll_interval_s=0, jsonl_path=sink)
+    collector.start(poll_loop=False)
+    try:
+        collector.poll()
+        run_doc = scrape_json(f"{collector.url}/profile")
+    finally:
+        collector.stop()
+        exp0.stop()
+        exp1.stop()
+    ranks_seen = set(run_doc.get("per_rank") or {})
+    if not {"0", "1"} <= ranks_seen:
+        raise AssertionError(
+            f"/profile per_rank missing ranks: {sorted(ranks_seen)}")
+    if "data_wait" not in (run_doc.get("buckets") or {}):
+        raise AssertionError(
+            f"rank1's data_wait bucket lost in the merge: "
+            f"{sorted(run_doc.get('buckets') or {})}")
+    merged_top = top_frames(run_doc, "compute", n=1)
+    if not merged_top or \
+            not merged_top[0][0].startswith("_profile_hot_planted"):
+        raise AssertionError(
+            f"merged /profile lost the planted frame: {merged_top}")
+
+    saved = os.path.join(workdir, "profile.json")
+    with open(saved, "w") as f:
+        f.write(json.dumps(run_doc))
+    for path, what in ((sink, "collector sink"),
+                       (saved, "saved /profile doc")):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = _timeline.main([path, "--profile"])
+        out_txt = buf.getvalue()
+        if rc != 0 or "_profile_hot_planted" not in out_txt:
+            raise AssertionError(
+                f"timeline --profile ({what}) failed (rc={rc}) or did "
+                f"not name the planted frame:\n{out_txt[:800]}")
+
+    # -- drift gate: per-tick sample cost vs prior rounds --------------
+    tol = float(os.environ.get("SPARKTORCH_TPU_PROFILE_DRIFT_TOL", "1.0"))
+    prior = _prior_window("profile", "sample_tick_us", k=3)
+    if prior is None:
+        drift = {"status": "no_prior_record", "tolerance": tol}
+    else:
+        drift = {
+            "status": "checked", "tolerance": tol,
+            "prior_median_us": round(prior["median"], 3),
+            "prior_n": prior["n"],
+            "ratio": round(sample_tick_us / max(prior["median"], 1e-9), 3),
+        }
+        if sample_tick_us > prior["median"] * (1.0 + tol) + 2.0:
+            raise AssertionError(
+                f"sample tick cost regressed: {sample_tick_us:.2f}us "
+                f"vs prior windowed median {prior['median']:.2f}us "
+                f"(past the {tol} relative tolerance + 2us floor); "
+                f"drift: {drift}")
+
+    return {
+        "config": "profile", "unit": "us (sample tick cost)",
+        "value": round(sample_tick_us, 3),
+        "sample_tick_us": round(sample_tick_us, 3),
+        "overhead_pct_of_step": round(100 * overhead_frac, 4),
+        "step_wall_off_ms": round(w_off * 1e3, 3),
+        "step_wall_on_ms": round(w_on * 1e3, 3),
+        "hz": float(doc0["hz"]),
+        "hot": {
+            "ticks": doc0["ticks"],
+            "bucket_samples": bucket_samples,
+            "hot_share": round(hot_share, 4),
+            "top_frame": frames[0][0],
+        },
+        "run_report": {
+            "n_ranks": run_doc["n_ranks"],
+            "samples_total": run_doc["samples_total"],
+            "buckets": sorted(run_doc["buckets"]),
+            "truncated": run_doc["truncated"],
+        },
+        "profile_drift": drift,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+    }
+
+
 def _bert_flops_accounting(module, batch: int, seq: int) -> dict:
     """Honest model-FLOPs accounting for the BERT classifier.
 
@@ -4710,6 +4929,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "elastic_ctl": bench_elastic_ctl,
     "obs_history": bench_obs_history,
     "goodput": bench_goodput,
+    "profile": bench_profile,
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "serve_online": bench_serve_online,
     "rpc_trace": bench_rpc_trace,

@@ -139,11 +139,25 @@ def build_context(payload: Dict[str, Any]) -> WorkerContext:
     ledger.start_auto_publish()
     ledger.publish()  # section visible from the FIRST scrape
     _goodput.install(ledger)
+    # And the stack sampler beside the ledger: the ledger says which
+    # bucket is stealing, the profiler says which function inside it.
+    # Env-gated (SPARKTORCH_TPU_PROFILE=0 disables); publishes
+    # throttled from its own thread, so a SIGKILLed worker's last-good
+    # snapshot still carries its final ``profile`` section.
+    from sparktorch_tpu.obs import profile as _profile
+
+    profiler = None
+    if _profile.enabled():
+        profiler = _profile.StackProfiler(telemetry=telemetry, rank=rank)
+        profiler.start()
+        profiler.publish()  # section visible from the FIRST scrape
+        _profile.install(profiler)
     ctx = WorkerContext(name, rank, cancel, heartbeat=heartbeat,
                         telemetry=telemetry, ctl=ctl)
     ctx._exporter = exporter  # kept alive for the process lifetime
     ctx._recorder = recorder
     ctx.ledger = ledger
+    ctx.profiler = profiler
     return ctx
 
 
@@ -215,6 +229,9 @@ def main(argv: Optional[list] = None) -> int:
         ledger = getattr(ctx, "ledger", None)
         if ledger is not None:
             ledger.close()
+        profiler = getattr(ctx, "profiler", None)
+        if profiler is not None:
+            profiler.stop()  # joins the sampler + final publish
     # A normal return is a fulfilled contract (entry fns drain by
     # returning early, with idempotent skip-on-restart semantics) —
     # exit 0 even when cancel fired late in the run.

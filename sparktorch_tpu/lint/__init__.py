@@ -30,6 +30,7 @@ from sparktorch_tpu.lint.rules_obs import (
     EventKindCollisionRule,
     JsonDumpRule,
     ObsPrintRule,
+    ProfilerApiRule,
     SpanContextMintRule,
     UrllibScrapeRule,
 )
@@ -46,6 +47,7 @@ ALL_RULES = (
     UrllibScrapeRule(),
     SpanContextMintRule(),
     EventKindCollisionRule(),
+    ProfilerApiRule(),
     TimingLedgerRule(),
     LockHoldRule(),
     RetraceHazardRule(),

@@ -1,4 +1,4 @@
-"""Observability-discipline rules (SPK101-106).
+"""Observability-discipline rules (SPK101-107).
 
 SPK101-105 are the AST migrations of the Makefile's historical
 ``lint-obs`` grep stanzas (print / bare span / json.dump / urllib
@@ -6,7 +6,9 @@ scraping / span-context minting); SPK106 encodes the
 ``Telemetry.event(kind=...)`` envelope-key collision the alerts WATCH
 documented (the sink record envelope is ``{"ts", "kind", "run_id"}``
 plus the collector's rank tag — a payload field with one of those
-names silently overwrites the envelope).
+names silently overwrites the envelope); SPK107 fences the
+interpreter's profiling hooks to ``obs/profile.py`` (the continuous
+stack sampler owns them).
 """
 
 from __future__ import annotations
@@ -142,6 +144,34 @@ class SpanContextMintRule(Rule):
                     "obs.rpctrace tracer helpers (root_span/child_span/"
                     "SpanContext.child), or annotate "
                     "`# lint-obs: ok (<why>)`")
+
+
+class ProfilerApiRule(Rule):
+    id = "SPK107"
+    slug = "profiler-api"
+    summary = "interpreter profiling hook used outside obs/profile.py"
+    why = ("sys.settrace/setprofile wreck jit dispatch for the whole "
+           "process and a second sys._current_frames() walker "
+           "double-pays the <1%-overhead budget bench-profile gates; "
+           "stack sampling goes through obs.profile.StackProfiler, "
+           "where rate, bounds, and bucket tagging stay audited")
+
+    HOOKS = ("sys._current_frames", "sys.settrace", "sys.setprofile")
+
+    def applies(self, rel: Optional[str]) -> bool:
+        return rel != "obs/profile.py"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.index.calls:
+            name = ctx.index.resolve(node.func)
+            if name in self.HOOKS:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() outside obs/profile.py: interpreter "
+                    f"profiling hooks belong to the continuous stack "
+                    f"sampler (obs.profile.StackProfiler) — sample "
+                    f"through it, or annotate a genuine debug dump "
+                    f"with `# lint-obs: ok (<why>)`")
 
 
 class EventKindCollisionRule(Rule):

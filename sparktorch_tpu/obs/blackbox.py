@@ -258,6 +258,24 @@ def collect_postmortem(out_dir: str, reason: str,
                    or telemetry.get_section(_goodput_mod.SECTION))
         if isinstance(section, Mapping):
             goodput = dict(section)
+    # The victim's last-good stack profile rides beside the ledger:
+    # the bucket doc says WHERE the time went, the profile says WHICH
+    # FUNCTION was holding it when the run died. Same source order —
+    # the collector's merge (it still holds a SIGKILLed rank's final
+    # throttled publish) wins, a driver-local section is the fallback.
+    profile = None
+    if collector is not None:
+        try:
+            profile = collector.profile_view()
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            profile = None
+    if profile is None and telemetry is not None:
+        from sparktorch_tpu.obs import profile as _profile_mod
+
+        section = (telemetry.get_section(_profile_mod.RUN_SECTION)
+                   or telemetry.get_section(_profile_mod.SECTION))
+        if isinstance(section, Mapping):
+            profile = dict(section)
     # Dedup (the controller's history events also flow through its
     # bus recorder) and order: identical (ts, kind, rank) triples
     # collapse, the narrative reads in time order. The controller's
@@ -293,6 +311,7 @@ def collect_postmortem(out_dir: str, reason: str,
         "events": unique,
         "metric_deltas": deltas,
         "goodput": goodput,
+        "profile": profile,
         "rpc_traces": rpc_traces,
         "heartbeats": heartbeats,
         "world": world,

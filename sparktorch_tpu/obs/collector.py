@@ -456,6 +456,7 @@ class FleetCollector:
         self._merge_xprof()
         self._stitch_rpc()
         self._merge_goodput()
+        self._merge_profile()
         merged = self.merged_snapshot()
         alert_events: List[Dict[str, Any]] = []
         if self.history is not None:
@@ -491,10 +492,23 @@ class FleetCollector:
                         "comm_source": run_doc.get("comm_source"),
                         "biggest_thief": run_doc.get("biggest_thief"),
                     })
+                # Same shape for the merged stack profile: one
+                # condensed `profile.run` line per sweep, full tries
+                # on the snapshot's sections (timeline --profile
+                # reads those back out of this very file).
+                profile_records: List[Dict[str, Any]] = []
+                prof_doc = (merged.get("sections") or {}).get("profile_run")
+                if isinstance(prof_doc, Mapping):
+                    profile_records.append({
+                        "kind": "profile.run", "ts": merged.get("ts"),
+                        "samples_total": prof_doc.get("samples_total"),
+                        "n_ranks": prof_doc.get("n_ranks"),
+                        "bursts": prof_doc.get("bursts"),
+                    })
                 write_jsonl(self.jsonl_path,
                             [{"kind": f"alert.{e['event']}", **e}
                              for e in alert_events]
-                            + goodput_records
+                            + goodput_records + profile_records
                             + [{"kind": "gang_snapshot", **merged,
                                 "heartbeats": self._merged_heartbeats()}],
                             append=True)
@@ -610,6 +624,38 @@ class FleetCollector:
         from sparktorch_tpu.obs import goodput as _goodput
 
         doc = self.telemetry.get_section(_goodput.RUN_SECTION)
+        return dict(doc) if isinstance(doc, Mapping) else None
+
+    def _merge_profile(self) -> None:
+        """Fold every scraped rank's ``profile`` section (plus this
+        collector's own bus's, when a driver-side sampler shares it)
+        into one run-level stack profile, published as the
+        ``profile_run`` section — the same path the goodput merge
+        takes, with the same last-good contract: a SIGKILLed rank's
+        final throttled publish keeps contributing its tries."""
+        from sparktorch_tpu.obs import profile as _profile
+
+        with self._lock:
+            snaps = {r: st.snapshot for r, st in self._ranks.items()}
+        docs = _profile.sections_from_snapshots(snaps)
+        own = self.telemetry.get_section(_profile.SECTION)
+        if isinstance(own, Mapping):
+            docs.setdefault("collector", own)
+        if not docs:
+            return
+        run = _profile.merge_sections(docs)
+        run["run_id"] = self.run_id
+        self.telemetry.set_section(_profile.RUN_SECTION, run)
+
+    def profile_view(self) -> Optional[Dict[str, Any]]:
+        """The merged stack profile ``GET /profile`` serves —
+        recomputed from the freshest last-good snapshots at read
+        time, like :meth:`goodput_view`. None when no rank has
+        published a profile section."""
+        self._merge_profile()
+        from sparktorch_tpu.obs import profile as _profile
+
+        doc = self.telemetry.get_section(_profile.RUN_SECTION)
         return dict(doc) if isinstance(doc, Mapping) else None
 
     # -- merged views ------------------------------------------------------
@@ -983,7 +1029,8 @@ class FleetCollector:
     def start(self, serve: bool = True,
               poll_loop: bool = True) -> "FleetCollector":
         """Start the HTTP surface (``/gang``, ``/metrics``,
-        ``/telemetry``) and — when ``poll_interval_s`` > 0 and
+        ``/telemetry``, ``/history``, ``/goodput``, ``/profile``,
+        ``POST /ctl``) and — when ``poll_interval_s`` > 0 and
         ``poll_loop`` — the background scrape loop."""
         if serve and self._httpd is None:
             from http.server import (
@@ -1030,6 +1077,17 @@ class FleetCollector:
                             self._send(404, json.dumps(
                                 {"ok": False,
                                  "error": "no goodput ledger published "
+                                          "by any scraped rank"}).encode(),
+                                content_type="application/json")
+                        else:
+                            self._send(200, json.dumps(doc).encode(),
+                                       content_type="application/json")
+                    elif route == "/profile":
+                        doc = collector.profile_view()
+                        if doc is None:
+                            self._send(404, json.dumps(
+                                {"ok": False,
+                                 "error": "no stack profile published "
                                           "by any scraped rank"}).encode(),
                                 content_type="application/json")
                         else:

@@ -141,6 +141,32 @@ def jit_cache_size(jitted: Any) -> Optional[int]:
 # spans from different layers must see each other's nesting).
 _TLS = threading.local()
 
+# Cross-thread view of the SAME stacks, keyed by thread ident — what
+# the stack profiler (obs/profile.py) samples: sys._current_frames()
+# hands it {ident: frame} and this registry answers "which ledger
+# bucket is open on that thread right now". Entries live only while a
+# thread has at least one open span (registered on the outermost
+# __enter__, dropped on the outermost __exit__), so a dead thread's
+# reused ident can never alias a stale stack. Mutated only under the
+# GIL by the owning thread; readers tolerate the pop race.
+_STACKS_BY_IDENT: Dict[int, List["LedgerSpan"]] = {}
+
+
+def open_span_buckets() -> Dict[int, str]:
+    """Snapshot {thread_ident: bucket of the innermost open span} for
+    every thread currently inside a LedgerSpan. The ``step``
+    pseudo-bucket reads as ``compute``: a sampler cannot split one
+    stack sample by the comm model, and compute is where step samples
+    overwhelmingly land. Safe to call from any thread."""
+    out: Dict[int, str] = {}
+    for ident, stack in list(_STACKS_BY_IDENT.items()):
+        try:
+            bucket = stack[-1].bucket
+        except IndexError:  # lost the race with the outermost __exit__
+            continue
+        out[ident] = "compute" if bucket == "step" else bucket
+    return out
+
 
 class LedgerSpan:
     """One timed attribution region. ALWAYS times (two perf_counter
@@ -187,6 +213,10 @@ class LedgerSpan:
         stack: List[LedgerSpan] = getattr(_TLS, "stack", None)
         if stack is None:
             stack = _TLS.stack = []
+        if not stack:
+            # Outermost span on this thread: expose the stack to the
+            # cross-thread sampler registry.
+            _STACKS_BY_IDENT[threading.get_ident()] = stack
         stack.append(self)
         self.t0 = time.perf_counter()
         return self
@@ -198,6 +228,8 @@ class LedgerSpan:
         stack: List[LedgerSpan] = getattr(_TLS, "stack", [])
         if stack and stack[-1] is self:
             stack.pop()
+        if not stack:
+            _STACKS_BY_IDENT.pop(threading.get_ident(), None)
         if stack:
             # Gross duration rolls up to the parent so the parent
             # attributes only its OWN (self) time — one second of

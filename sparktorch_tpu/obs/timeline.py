@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from sparktorch_tpu.obs.xprof import (
     GangAnalysis,
@@ -427,6 +427,129 @@ def _goodput_from_jsonl(records: List[Dict[str, Any]]
 
 
 # ---------------------------------------------------------------------------
+# Stack-profile rendering (per-bucket top-down trees)
+# ---------------------------------------------------------------------------
+
+
+def render_profile_report(doc: Dict[str, Any], top: int = 10) -> str:
+    """One terminal page from a stack-profile doc (the collector's
+    ``GET /profile`` document, or a single rank's ``profile``
+    section): per ledger bucket, the hottest self-time frame and a
+    flamegraph-style top-down tree. ``top`` caps the tree lines per
+    bucket; children below 2%% of their bucket are pruned (they are
+    noise at sampling resolution)."""
+    from sparktorch_tpu.obs.profile import top_frames
+
+    total = int(doc.get("samples_total") or 0)
+    lines = [
+        f"profile: {total} samples over "
+        f"{float(doc.get('wall_s') or 0.0):.2f}s"
+        + (f" ({doc.get('n_ranks')} ranks)"
+           if doc.get("n_ranks") is not None else
+           (f" (rank {doc['rank']})"
+            if doc.get("rank") is not None else ""))
+        + (f" @ {float(doc['hz']):g}Hz" if doc.get("hz") else "")
+        + (f"   run: {doc['run_id']}" if doc.get("run_id") else ""),
+    ]
+    if doc.get("bursts"):
+        lines.append(f"burst windows: {doc['bursts']} "
+                     f"(alert-triggered high-rate captures)")
+    if doc.get("truncated"):
+        lines.append(f"note: {doc['truncated']} stacks truncated at "
+                     f"max depth (leaf side kept)")
+    buckets = doc.get("buckets") or {}
+    ranked = sorted(buckets.items(),
+                    key=lambda kv: -int((kv[1] or {}).get("samples", 0)))
+    for bucket, root in ranked:
+        n = int((root or {}).get("samples", 0))
+        if n <= 0:
+            continue
+        share = n / max(total, 1)
+        lines.append("")
+        lines.append(f"[{bucket}] {n} samples "
+                     f"({100 * share:.1f}% of run)")
+        hot = top_frames(doc, bucket, 1)
+        if hot:
+            lines.append(f"  hot: {hot[0][0]}  self={hot[0][1]} "
+                         f"({100 * hot[0][1] / max(n, 1):.1f}% of bucket)")
+        budget = [max(int(top), 1)]
+        floor = max(n * 0.02, 0.5)
+
+        def walk(node, depth):
+            kids = sorted((node.get("children") or {}).items(),
+                          key=lambda kv: (-kv[1].get("samples", 0),
+                                          kv[0]))
+            for name, child in kids:
+                cn = int(child.get("samples", 0))
+                if cn < floor:
+                    continue
+                if budget[0] <= 0:
+                    lines.append("    " + "  " * depth + "...")
+                    return
+                budget[0] -= 1
+                own = int(child.get("self", 0))
+                lines.append(
+                    "    " + "  " * depth
+                    + f"{name}  {cn} ({100 * cn / max(n, 1):.1f}%)"
+                    + (f" [self {own}]" if own else ""))
+                walk(child, depth + 1)
+
+        walk(root, 0)
+    return "\n".join(lines) + "\n"
+
+
+def render_profile_diff(diff: Dict[str, Any], top: int = 10) -> str:
+    """Render a :func:`~sparktorch_tpu.obs.profile.diff_docs` output:
+    per bucket, the frames whose self-time SHARE of the bucket moved
+    most (positive delta = the frame grew since the prior profile)."""
+    lines = [
+        f"profile diff: {diff.get('current_samples', 0)} samples now "
+        f"vs {diff.get('prior_samples', 0)} prior",
+    ]
+    buckets = diff.get("buckets") or {}
+    ranked = sorted(buckets.items(),
+                    key=lambda kv: -int((kv[1] or {}).get(
+                        "current_samples", 0)))
+    moved = False
+    for bucket, bdoc in ranked:
+        frames = (bdoc or {}).get("frames") or []
+        if not frames:
+            continue
+        moved = True
+        lines.append("")
+        lines.append(
+            f"[{bucket}] {bdoc.get('current_samples', 0)} samples now "
+            f"vs {bdoc.get('prior_samples', 0)} prior")
+        for f in frames[:max(int(top), 1)]:
+            delta = float(f.get("delta") or 0.0)
+            lines.append(
+                f"  {delta:>+7.1%}  {f.get('frame')}"
+                f"  ({float(f.get('current_share') or 0):.1%}"
+                f" <- {float(f.get('prior_share') or 0):.1%})")
+    if not moved:
+        lines.append("no frame moved (identical shares)")
+    return "\n".join(lines) + "\n"
+
+
+def _profile_from_jsonl(records: List[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """The newest stack profile in a JSONL file: a collector sink/dump
+    record carrying the merged ``profile_run`` section wins; a bare
+    rank dump's ``profile`` section renders as one rank."""
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        doc = sections.get("profile_run")
+        if isinstance(doc, dict) and doc.get("buckets"):
+            return doc
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        doc = sections.get("profile")
+        if isinstance(doc, dict) and doc.get("buckets"):
+            return doc
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Postmortem rendering (flight-recorder bundles)
 # ---------------------------------------------------------------------------
 
@@ -508,6 +631,24 @@ def render_postmortem_report(doc: Dict[str, Any], top: int = 40) -> str:
             + (f", biggest thief {thief[0]} {thief[1]:.2f}s"
                if thief else "")
             + f" (comm: {gp.get('comm_source', 'none')})")
+    prof = doc.get("profile")
+    if isinstance(prof, dict) and prof.get("buckets"):
+        from sparktorch_tpu.obs.profile import top_frames
+
+        lines.append("")
+        lines.append(
+            f"stack profile at death: "
+            f"{prof.get('samples_total', 0)} samples")
+        pbuckets = sorted(
+            (prof.get("buckets") or {}).items(),
+            key=lambda kv: -int((kv[1] or {}).get("samples", 0)))
+        for bucket, root in pbuckets[:4]:
+            n = int((root or {}).get("samples", 0))
+            hot = top_frames(prof, bucket, 1)
+            if n <= 0 or not hot:
+                continue
+            lines.append(f"  {bucket:<18} {n:>6} samples"
+                         f"  hot: {hot[0][0]} [self {hot[0][1]}]")
     traces = doc.get("rpc_traces") or []
     if traces:
         lines.append("")
@@ -579,7 +720,7 @@ class FollowReader:
 # Record kinds --follow renders (everything else is metric volume the
 # tail mode exists to cut through). "span" is deliberately absent.
 _FOLLOW_PREFIXES = ("alert.", "ctl.", "ft_", "chaos", "gang_snapshot",
-                    "goodput")
+                    "goodput", "profile")
 
 
 def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
@@ -864,6 +1005,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "goodput_run/goodput section): stacked "
                              "attribution bar per rank, biggest thief "
                              "named")
+    parser.add_argument("--profile", action="store_true",
+                        help="render a ledger-keyed stack profile "
+                             "(a saved GET /profile document, or a "
+                             "collector/telemetry .jsonl carrying the "
+                             "profile_run/profile section): per-bucket "
+                             "top-down trees, hottest frame named")
+    parser.add_argument("--diff", metavar="PRIOR", default=None,
+                        help="with --profile: compare against a prior "
+                             "profile document/JSONL and render the "
+                             "frames whose bucket share moved")
     parser.add_argument("--json", action="store_true",
                         help="emit the raw analysis dict as JSON")
     parser.add_argument("--top", type=int, default=None,
@@ -879,10 +1030,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.top = 40 if args.postmortem else 10
 
     if sum((args.gang, args.tune, args.rpc, args.postmortem,
-            args.follow, args.goodput)) > 1:
-        print("error: --gang, --tune, --rpc, --postmortem, --follow "
-              "and --goodput are different reports; pick one")
+            args.follow, args.goodput, args.profile)) > 1:
+        print("error: --gang, --tune, --rpc, --postmortem, --follow, "
+              "--goodput and --profile are different reports; pick one")
         return 2
+    if args.diff is not None and not args.profile:
+        print("error: --diff goes with --profile")
+        return 2
+    if args.profile:
+        return _main_profile(args)
     if args.goodput:
         return _main_goodput(args)
     if args.tune:
@@ -997,6 +1153,66 @@ def _main_goodput(args) -> int:
                   f"(no buckets)")
             return 1
     print(json.dumps(doc) if args.json else render_goodput_report(doc),
+          end="" if not args.json else "\n")
+    return 0
+
+
+def _load_profile_doc(path: str) -> Tuple[Optional[Dict[str, Any]], int]:
+    """A stack-profile doc from a saved /profile JSON document or a
+    JSONL carrying the profile_run/profile section; (None, rc) on
+    failure, with the error already printed."""
+    if _looks_like_jsonl(path):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(path)
+        except OSError as e:
+            print(f"error: {e}")
+            return None, 1
+        doc = _profile_from_jsonl(records)
+        if doc is None:
+            print(f"no stack profile (sections.profile_run / "
+                  f"sections.profile) in {path}")
+            return None, 1
+        return doc, 0
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}")
+        return None, 1
+    buckets = doc.get("buckets") if isinstance(doc, dict) else None
+    if not (isinstance(buckets, dict)
+            and all(isinstance(v, dict) and "children" in v
+                    for v in buckets.values())):
+        print(f"error: {path} is not a stack-profile document "
+              f"(no per-bucket tries)")
+        return None, 1
+    return doc, 0
+
+
+def _main_profile(args) -> int:
+    """--profile: render a profile doc; with --diff, the movement
+    against a prior one."""
+    if len(args.paths) > 1:
+        print("error: --profile renders one file at a time")
+        return 2
+    doc, rc = _load_profile_doc(args.paths[0])
+    if doc is None:
+        return rc
+    if args.diff is not None:
+        prior, rc = _load_profile_doc(args.diff)
+        if prior is None:
+            return rc
+        from sparktorch_tpu.obs.profile import diff_docs
+
+        diff = diff_docs(doc, prior)
+        print(json.dumps(diff) if args.json
+              else render_profile_diff(diff, top=args.top),
+              end="" if not args.json else "\n")
+        return 0
+    print(json.dumps(doc) if args.json
+          else render_profile_report(doc, top=args.top),
           end="" if not args.json else "\n")
     return 0
 

@@ -1128,13 +1128,18 @@ def pp_schedule_metas(sizes: Mapping[str, int], cfg,
                       max_virtual: int = 4) -> List[Dict[str, Any]]:
     """Legal schedule candidates for one pp>1 mesh: ``gpipe`` and
     ``1f1b`` (V=1), plus every ``interleaved`` V in [2, max_virtual]
-    with ``n_layers % (pp*V) == 0`` — each with a deterministic
-    ``n_micro`` (the largest M <= max(2*pp, 4) dividing the per-dp-
-    shard rows; interleaved additionally needs M % pp == 0). Empty
-    when the pipeline trainer cannot run this mesh at all (non-
-    transformer spec, MoE x tp, sp>1 without ring attention, no legal
-    microbatch split, non-uniform dense/MoE stage pattern) — those
-    meshes simply don't enter the candidate list, mirroring
+    with ``n_layers % (pp*V) == 0`` — each fanned out over EVERY legal
+    ``n_micro`` (M <= max(2*pp, 4) dividing the per-dp-shard rows;
+    interleaved additionally needs M % pp == 0). The schedule-aware
+    bubble term (S-1)/(M+S-1) and the per-tick alpha charge pull in
+    opposite directions — more microbatches shrink the bubble but pay
+    more launches — so M is a real search dimension the cost model
+    ranks, not a heuristic pick; the cap keeps the fan-out bounded
+    (microbatches beyond ~2S shave little bubble but still multiply
+    ticks). Empty when the pipeline trainer cannot run this mesh at
+    all (non-transformer spec, MoE x tp, sp>1 without ring attention,
+    no legal microbatch split, non-uniform dense/MoE stage pattern) —
+    those meshes simply don't enter the candidate list, mirroring
     ``make_pp_train_step``'s own validation."""
     S = int(sizes.get("pp", 1))
     if S <= 1 or cfg is None or not hasattr(cfg, "n_layers"):
@@ -1168,33 +1173,29 @@ def pp_schedule_metas(sizes: Mapping[str, int], cfg,
         chunks = [pattern[i * c:(i + 1) * c] for i in range(n_chunks)]
         return all(ch == chunks[0] for ch in chunks)
 
-    def _pick_m(multiple: int) -> Optional[int]:
+    def _legal_ms(multiple: int) -> List[int]:
         cap = max(2 * S, 4)
-        best = None
-        for m in range(multiple, per_shard + 1, multiple):
-            if m > cap:
-                break
-            if per_shard % m == 0:
-                best = m
-        return best
+        return [m for m in range(multiple, min(per_shard, cap) + 1,
+                                 multiple)
+                if per_shard % m == 0]
 
     metas: List[Dict[str, Any]] = []
     if _uniform(S):
-        m = _pick_m(1)
-        if m is not None:
+        for m in _legal_ms(1):
             metas.append({"schedule": "gpipe", "virtual_stages": 1,
                           "n_micro": m})
             metas.append({"schedule": "1f1b", "virtual_stages": 1,
                           "n_micro": m})
-    m_int = _pick_m(S)            # interleaved ticks need M % pp == 0
-    if m_int is not None:
+    ms_int = _legal_ms(S)         # interleaved ticks need M % pp == 0
+    if ms_int:
         # range is empty when max_virtual < 2: a caller disabling
         # interleaving gets exactly gpipe + 1f1b.
         for v in range(2, int(max_virtual) + 1):
             if n_layers % (S * v) != 0 or not _uniform(S * v):
                 continue
-            metas.append({"schedule": "interleaved", "virtual_stages": v,
-                          "n_micro": m_int})
+            for m in ms_int:
+                metas.append({"schedule": "interleaved",
+                              "virtual_stages": v, "n_micro": m})
     return metas
 
 
@@ -1271,7 +1272,12 @@ def tune_cache_key(shape: WorkloadShape, caps: Mapping[str, Sequence[int]],
         # schedule-aware bubble/tick terms in the cost model, winners
         # may carry a best_schedule) — a pre-rewrite entry searched
         # with pp locked to 1 must not satisfy the opened space.
-        "schema": 3,
+        # Schema 4: n_micro opened to the search (every legal M <=
+        # max(2*pp, 4) fans out per schedule x V instead of the
+        # deterministic largest-M pick) — an entry whose candidates
+        # were enumerated under the single-M heuristic must not
+        # satisfy the widened space.
+        "schema": 4,
         "moe_dispatch": "shard_map_a2a",
         "pp_schedules": list(PP_SCHEDULES),
         "shape": dataclasses.asdict(shape),

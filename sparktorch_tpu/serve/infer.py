@@ -679,6 +679,11 @@ def run_replica_server(torch_obj, replica_id="0",
     variables = dict(spec.init_params(jax.random.key(seed)))
     params = variables.pop("params", variables)
     telemetry = getattr(ctx, "telemetry", None)
+    # Stack sampler beside the replica's ledger (the ctl entry
+    # installs both; a bare in-process replica gets them here).
+    from sparktorch_tpu.obs import profile as _profile
+
+    _profile.ensure(telemetry)
     replica = InferenceReplica(
         spec.make_module(), params, model_state=variables or None,
         replica_id=replica_id, buckets=buckets,

@@ -37,6 +37,7 @@ import numpy as np
 
 from sparktorch_tpu.ft.policy import FtPolicy
 from sparktorch_tpu.net.transport import TransportError
+from sparktorch_tpu.obs import goodput as _goodput
 from sparktorch_tpu.serve.infer import (
     DeadlineExceeded,
     InferenceReplica,
@@ -233,6 +234,12 @@ class Router:
         """Launch the background health loop (optional — an in-process
         tier that only ever fails on submit can rely on the inline
         sweeps)."""
+        # Stack sampler beside the router's goodput attribution
+        # (site=router spans in submit): serving processes profile
+        # like training ones.
+        from sparktorch_tpu.obs import profile as _profile
+
+        _profile.ensure(self.telemetry)
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(target=self._health_loop,
@@ -347,7 +354,12 @@ class Router:
                     # The request's own deadline stays the shed knob:
                     # a client that wants a fast tier-wide 429 passes
                     # a short deadline.
-                    time.sleep(wait_s)
+                    # Retry backoff is ROUTER-attributed wall: the
+                    # goodput ledger's serving story stops at replicas
+                    # without it (ROADMAP's "route/hop/retry work").
+                    with _goodput.span("exposed_comm",
+                                       {"site": "router_retry"}):
+                        time.sleep(wait_s)
                     wait_s = min(wait_s * 2, 0.1)
                     continue
                 wait_s = min(0.02, self.probe_interval_s)
@@ -357,7 +369,13 @@ class Router:
                 remaining = max(deadline - time.monotonic(), 0.001)
                 with tracer.child_span("replica", root.ctx,
                                        kind="client",
-                                       replica=rid) as tsp:
+                                       replica=rid) as tsp, \
+                        _goodput.span("exposed_comm",
+                                      {"site": "router"}):
+                    # The hop (submit + queue + replica wall) is
+                    # router-attributed exposed_comm on THIS process's
+                    # ledger; the replica's own ledger attributes its
+                    # compute — different processes, no double count.
                     try:
                         fut = st.handle.submit(
                             x, deadline_s=remaining,

@@ -580,6 +580,12 @@ def train_async(
     bus ``/metrics`` scrapes.
     """
     tele = telemetry or get_telemetry()
+    # Stack sampler beside the ambient ledger: the async trainer's N
+    # worker lanes all sample into the same per-process tries, each
+    # tagged by the bucket open on ITS thread.
+    from sparktorch_tpu.obs import profile as _profile
+
+    _profile.ensure(tele)
     if ft_policy is not None:
         supervise = True
     spec = deserialize_model(torch_obj)

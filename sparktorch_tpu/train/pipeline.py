@@ -2549,6 +2549,10 @@ def train_distributed_pipeline(
 
     tele = telemetry or get_telemetry()
     log = get_logger("sparktorch_tpu.train")
+    # Stack sampler beside the ambient ledger (see train/sync.py).
+    from sparktorch_tpu.obs import profile as _profile
+
+    _profile.ensure(tele)
 
     module = spec.make_module()
     if isinstance(module, CausalLM):

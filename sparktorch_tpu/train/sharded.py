@@ -493,9 +493,14 @@ def make_sharded_train_step(
 
     from sparktorch_tpu.obs import get_telemetry
     from sparktorch_tpu.obs import goodput as _goodput
+    from sparktorch_tpu.obs import profile as _stackprof
     from sparktorch_tpu.utils.tracing import profile_run, step_annotation
 
     tele = telemetry or get_telemetry()
+    # Stack sampler beside the ambient ledger (see train/sync.py) —
+    # the caller owns the loop here, so the step factory is where
+    # "wherever ledgers live" lands for the GSPMD path.
+    _stackprof.ensure(tele)
     loop_state = {"calls": 0, "profiler": None, "handle": None}
     # The comm model the goodput ledger starts under: the tuner's
     # measured exposed fraction for the winning mesh when the auto

@@ -227,6 +227,12 @@ def train_distributed(
         )
 
     tele = telemetry or get_telemetry()
+    # The continuous stack sampler lives wherever ledgers live: the
+    # ambient ledger names the thieving bucket, the sampler names the
+    # function inside it. Env-gated; idempotent per process.
+    from sparktorch_tpu.obs import profile as _profile
+
+    _profile.ensure(tele)
     if pre_sharded:
         # ``data`` is already a globally-sharded DataBatch (multi-host
         # path, train_distributed_multihost) — do not re-place it.
@@ -781,6 +787,10 @@ def train_distributed_streaming(
 
     tele = telemetry or get_telemetry()
     log = get_logger("sparktorch_tpu.train")
+    # Stack sampler beside the ambient ledger (see train_distributed).
+    from sparktorch_tpu.obs import profile as _profile
+
+    _profile.ensure(tele)
     recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele,
                                prefix="train_streaming")
     # Fold the restored step into the shuffle seed: a resumed run must

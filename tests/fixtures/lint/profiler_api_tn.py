@@ -1,0 +1,17 @@
+"""True negatives for SPK107: benign sys usage, a same-named method
+on a non-sys object, and the annotated escape hatch."""
+import sys
+
+
+def interpreter_info():
+    return sys.version_info
+
+
+def not_the_interpreter(harness):
+    # A settrace METHOD on some other object is not sys.settrace.
+    harness.settrace(True)
+    return harness
+
+
+def frames_with_waiver():
+    return sys._current_frames()  # lint-obs: ok (one-shot debug dump on watchdog timeout)

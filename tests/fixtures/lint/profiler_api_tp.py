@@ -1,0 +1,17 @@
+"""True positives for SPK107: interpreter profiling hooks called
+outside obs/profile.py (including the aliased-import form the old
+grep bans could never see)."""
+import sys
+from sys import setprofile as sp
+
+
+def snapshot_stacks():
+    return sys._current_frames()
+
+
+def arm_tracer(fn):
+    sys.settrace(fn)
+
+
+def arm_profiler(fn):
+    sp(fn)

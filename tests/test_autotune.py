@@ -850,16 +850,19 @@ def test_pp_schedule_metas_legality():
     cfg = tiny_transformer(n_layers=4, max_len=16)
     sizes = {"dp": 4, "fsdp": 1, "tp": 1, "sp": 1, "ep": 1, "pp": 2}
     metas = pp_schedule_metas(sizes, cfg, global_batch=32)
-    # gpipe + 1f1b at the deterministic M (largest <= max(2S,4)=4
-    # dividing per-shard rows 8), plus interleaved V=2 (4 layers / 2
-    # stages): M must be a multiple of S there.
-    assert {m["schedule"] for m in metas} == {"gpipe", "1f1b",
-                                              "interleaved"}
+    # n_micro is a search dimension: EVERY legal M <= max(2S,4)=4
+    # dividing per-shard rows 8 fans out per schedule ({1,2,4} for
+    # gpipe/1f1b; {2,4} for interleaved, where M % pp == 0), plus
+    # interleaved V=2 only (4 layers / 2 stages).
+    assert {(m["schedule"], m["virtual_stages"], m["n_micro"])
+            for m in metas} == {
+        ("gpipe", 1, 1), ("gpipe", 1, 2), ("gpipe", 1, 4),
+        ("1f1b", 1, 1), ("1f1b", 1, 2), ("1f1b", 1, 4),
+        ("interleaved", 2, 2), ("interleaved", 2, 4)}
     for m in metas:
-        assert m["n_micro"] == 4
         assert (32 // sizes["dp"]) % m["n_micro"] == 0
+        assert m["n_micro"] <= max(2 * sizes["pp"], 4)
         if m["schedule"] == "interleaved":
-            assert m["virtual_stages"] == 2
             assert cfg.n_layers % (2 * m["virtual_stages"]) == 0
             assert m["n_micro"] % sizes["pp"] == 0
     # 2 layers cannot interleave over pp=2 (n_layers % (S*V) != 0).
@@ -928,10 +931,13 @@ def test_autotune_expands_pp_schedules_and_keeps_pure_dp():
                           artifact_path=artifact)
         loaded = TuneResult.load(artifact)
     labels = [c.label for c in result.candidates]
-    # Pure dp is present, and the pp meshes fan out per schedule.
+    # Pure dp is present, and the pp meshes fan out per schedule AND
+    # per legal n_micro (per-shard rows 4 -> M in {1, 2, 4}).
     assert "dp8" in labels
     assert "dp4xpp2-gpipe_m4" in labels
     assert "dp4xpp2-1f1b_m4" in labels
+    assert "dp4xpp2-gpipe_m2" in labels
+    assert "dp4xpp2-gpipe_m1" in labels
     # n_layers=2 cannot interleave over pp=2.
     assert not any("int" in l for l in labels)
     # Every pp candidate carries legal schedule meta (divisibility).

@@ -50,6 +50,7 @@ TRUE_POSITIVES = {
     "collective_tp.py": {"SPK402": 2},
     "obs_misc_tp.py": {"SPK101": 1, "SPK102": 1, "SPK103": 1,
                        "SPK104": 1, "SPK105": 1},
+    "profiler_api_tp.py": {"SPK107": 3},
 }
 
 TRUE_NEGATIVES = [
@@ -60,6 +61,7 @@ TRUE_NEGATIVES = [
     "retrace_tn.py",
     "collective_tn.py",
     "obs_misc_tn.py",
+    "profiler_api_tn.py",
     "suppressed_ok.py",
 ]
 

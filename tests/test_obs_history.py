@@ -351,6 +351,53 @@ def test_collector_fallback_serves_history_from_peer_sink(tmp_path):
         secondary.stop()
 
 
+def test_collector_fallback_history_latest_and_since_ts(tmp_path):
+    """The reconstructed fallback ring answers the point-lookup
+    queries too, over the real HTTP route: ``query=latest`` returns
+    the newest retained value (gauge and field-projected digest alike)
+    and ``delta&since_ts=`` windows the counter increase from the
+    sweep at-or-before the cut — every answer stamped
+    ``source=fallback_jsonl``."""
+    from sparktorch_tpu.obs import ScrapeError, scrape_json
+
+    sink = str(tmp_path / "primary.jsonl")
+    with open(sink, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({
+                "kind": "gang_snapshot", "ts": float(10 + i),
+                "counters": {"req_total": float(i * 3)},
+                "gauges": {"loss": 2.0 - 0.25 * i},
+                "ranks": {}}) + "\n")
+    secondary = FleetCollector({0: "http://127.0.0.1:1/"},
+                               poll_interval_s=0, fallback_jsonl=sink)
+    secondary.start(poll_loop=False)
+    try:
+        base = secondary.url + "/history"
+        # describe: the ring itself is the reconstruction.
+        desc = scrape_json(base)
+        assert desc["source"] == "fallback_jsonl"
+        assert desc["sweeps"] == 5
+        # latest: newest retained gauge value (ts 14 -> 1.0).
+        latest = scrape_json(base + "?name=loss&query=latest")
+        assert latest["source"] == "fallback_jsonl"
+        assert latest["value"] == pytest.approx(1.0)
+        # delta since ts=12: counter 6 -> 12 across the newer sweeps.
+        delta = scrape_json(base + "?name=req_total&query=delta"
+                            "&since_ts=12")
+        assert delta["source"] == "fallback_jsonl"
+        assert delta["since_ts"] == 12.0
+        assert delta["value"] == pytest.approx(6.0)
+        # since_ts predating retention degrades to the full increase.
+        delta_all = scrape_json(base + "?name=req_total&query=delta"
+                                "&since_ts=0")
+        assert delta_all["value"] == pytest.approx(12.0)
+        # delta without its required since_ts is a 400 over the wire.
+        with pytest.raises(ScrapeError):
+            scrape_json(base + "?name=req_total&query=delta")
+    finally:
+        secondary.stop()
+
+
 # ---------------------------------------------------------------------------
 # Satellite: rpc_traces cap-32 retention + stale-scrape accounting
 # ---------------------------------------------------------------------------
