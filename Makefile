@@ -8,8 +8,8 @@ PYTHON ?= python
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
 	bench-ps-fleet bench-tune bench-pp-tune bench-rpc-trace \
 	bench-serve bench-elastic bench-obs-history bench-moe \
-	bench-goodput bench-profile bench-lint cluster-up clean lint \
-	lint-obs
+	bench-goodput bench-profile bench-health bench-lint cluster-up \
+	clean lint lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -275,6 +275,25 @@ bench-goodput:
 bench-profile:
 	$(PYTHON) -m sparktorch_tpu.bench --config profile \
 		--log benchmarks/bench_r14_profile.jsonl
+
+# Model-health observability gate: a seeded poison batch on a real
+# train_distributed run must trip the NaN sentinel AT the poisoned
+# step within 2 steps of the health ledger's delayed fetch, and the
+# replay bundle it writes must reproduce the bad step BITWISE in a
+# fresh process (`python -m sparktorch_tpu.obs.replay` exits 0); the
+# latched health_nonfinite alert fires exactly one episode; an
+# interleaved A/A pair must show the health fetch attributed in
+# data_wait{site=health} (off arm exactly 0.0) with < 1% step-wall
+# overhead and ZERO anomalies/alerts on the clean leg; the drill
+# rank's section must merge rank-tagged into `GET /health` and render
+# via `timeline --health`, `--follow`, and `--postmortem` — FAILS
+# otherwise. The record is retained (--log) so the note_step-cost
+# drift gate arms against the windowed median of prior rounds
+# (SPARKTORCH_TPU_HEALTH_DRIFT_TOL, relative, default 0.5). Runs on
+# any backend (JAX_PLATFORMS=cpu works).
+bench-health:
+	$(PYTHON) -m sparktorch_tpu.bench --config health \
+		--log benchmarks/bench_r15_health.jsonl
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

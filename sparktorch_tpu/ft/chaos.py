@@ -97,6 +97,13 @@ class ChaosConfig:
     # name it.
     slow_replica_s: Mapping[Any, float] = dataclasses.field(
         default_factory=dict)
+    # worker/rank -> step: at the 'data.batch' site, tell the trainer
+    # to poison its resident batch (NaN in the feature rows — see
+    # poison_batch) before dispatching that step. One-shot per worker:
+    # the drill needs exactly one bad step, then clean recovery
+    # steps for the detectors/alerts to resolve against.
+    poison_batch_at: Mapping[int, int] = dataclasses.field(
+        default_factory=dict)
     # rank -> step: deliver a raw SIGKILL to that rank's PROCESS
     # worker once its heartbeat reports reaching the step — the
     # NON-COOPERATIVE death the thread deployment can never exercise
@@ -131,6 +138,7 @@ class ChaosInjector:
         self._replica_requests: Dict[str, int] = {}
         self._replica_kills_fired: set = set()
         self._process_kills_fired: set = set()
+        self._poisons_fired: set = set()
 
     def _record(self, site: str, **ctx: Any) -> None:
         self.events.append({"site": site, **ctx})
@@ -213,6 +221,21 @@ class ChaosInjector:
                                      route=ctx.get("route"))
                         action["die"] = True
             return action or None
+        elif site == "data.batch":
+            # Poison-batch injection (the model-health drill): the
+            # trainer must act on {"poison": True} by replacing its
+            # batch with a NaN-poisoned copy BEFORE dispatch, so the
+            # health ledger's replay anchor records the poisoned
+            # batch. One-shot per worker.
+            worker = ctx.get("worker")
+            at = cfg.poison_batch_at.get(worker)
+            if at is not None and ctx.get("step", -1) >= at:
+                with self._lock:
+                    if worker in self._poisons_fired:
+                        return None
+                    self._poisons_fired.add(worker)
+                    self._record(site, **ctx)
+                return {"poison": True}
         elif site == "ctl.process":
             # Non-cooperative process kill: the handle's liveness poll
             # asks "should this rank die NOW?" with the step its
@@ -289,6 +312,22 @@ def fire(site: str, **ctx: Any) -> Optional[Dict[str, Any]]:
     if inj is None:
         return None
     return inj.fire(site, **ctx)
+
+
+def poison_batch(batch: Any) -> Any:
+    """NaN-poison the first feature row of a DataBatch-shaped pytree
+    (the action a {"poison": True} verdict from the 'data.batch' site
+    demands). Returns a NEW batch — device buffers are immutable, and
+    the fresh identity is load-bearing: the health ledger re-anchors
+    its replay snapshot on batch-identity change, so the recorded
+    bundle holds exactly the poisoned bytes that dispatched."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(batch.x).at[0].set(jnp.nan)
+    try:
+        return batch._replace(x=x)
+    except AttributeError:
+        return type(batch)(x=x, y=batch.y, w=batch.w)
 
 
 @contextlib.contextmanager

@@ -26,6 +26,7 @@ from sparktorch_tpu.lint.rules_jax import (
 from sparktorch_tpu.lint.rules_lifecycle import HandleLifecycleRule
 from sparktorch_tpu.lint.rules_locks import LockHoldRule
 from sparktorch_tpu.lint.rules_obs import (
+    AsyncFetchRule,
     BareSpanRule,
     EventKindCollisionRule,
     JsonDumpRule,
@@ -48,6 +49,7 @@ ALL_RULES = (
     SpanContextMintRule(),
     EventKindCollisionRule(),
     ProfilerApiRule(),
+    AsyncFetchRule(),
     TimingLedgerRule(),
     LockHoldRule(),
     RetraceHazardRule(),

@@ -1,4 +1,4 @@
-"""Observability-discipline rules (SPK101-107).
+"""Observability-discipline rules (SPK101-108).
 
 SPK101-105 are the AST migrations of the Makefile's historical
 ``lint-obs`` grep stanzas (print / bare span / json.dump / urllib
@@ -8,7 +8,9 @@ documented (the sink record envelope is ``{"ts", "kind", "run_id"}``
 plus the collector's rank tag — a payload field with one of those
 names silently overwrites the envelope); SPK107 fences the
 interpreter's profiling hooks to ``obs/profile.py`` (the continuous
-stack sampler owns them).
+stack sampler owns them); SPK108 keeps device->host readbacks in the
+trainers inside an attributed ledger span (the async-dispatch
+discipline the health ledger's delayed fetch exists to preserve).
 """
 
 from __future__ import annotations
@@ -34,7 +36,7 @@ class ObsPrintRule(Rule):
     # CLIs whose stdout is their contract (same set the grep excluded,
     # plus the analyzer's own CLI).
     EXEMPT = ("bench.py", "net/bench_wire.py", "obs/timeline.py",
-              "parallel/tune.py", "lint/cli.py")
+              "obs/replay.py", "parallel/tune.py", "lint/cli.py")
 
     def applies(self, rel: Optional[str]) -> bool:
         return rel not in self.EXEMPT
@@ -172,6 +174,59 @@ class ProfilerApiRule(Rule):
                     f"sampler (obs.profile.StackProfiler) — sample "
                     f"through it, or annotate a genuine debug dump "
                     f"with `# lint-obs: ok (<why>)`")
+
+
+class AsyncFetchRule(Rule):
+    id = "SPK108"
+    slug = "obs-async-fetch"
+    summary = ("unattributed device sync (jax.device_get/"
+               "block_until_ready) in train/")
+    why = ("a raw readback in a trainer stalls the async dispatch "
+           "pipeline AND hides the stall from the goodput ledger — the "
+           "health ledger's delayed fetch exists so numerics readbacks "
+           "land K steps late under data_wait{site=health}; any sync "
+           "the trainers do must sit inside a ledger span so the time "
+           "is attributed, not silently lost")
+
+    SYNC_CALLS = ("jax.device_get", "jax.block_until_ready")
+    SPAN_ATTRS = ("span", "step_span")
+
+    def applies(self, rel: Optional[str]) -> bool:
+        return rel is None or rel.startswith("train/")
+
+    def _in_ledger_span(self, ctx: FileContext, node: ast.AST) -> bool:
+        for anc in ctx.index.parent_chain(node):
+            if not isinstance(anc, (ast.With, ast.AsyncWith)):
+                continue
+            for item in anc.items:
+                expr = item.context_expr
+                if (isinstance(expr, ast.Call)
+                        and isinstance(expr.func, ast.Attribute)
+                        and expr.func.attr in self.SPAN_ATTRS):
+                    return True
+        return False
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.index.calls:
+            name = ctx.index.resolve(node.func)
+            is_sync = name in self.SYNC_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready")
+            if not is_sync:
+                continue
+            if self._in_ledger_span(ctx, node):
+                continue
+            what = (name if name in self.SYNC_CALLS
+                    else ".block_until_ready()")
+            yield self.finding(
+                ctx, node,
+                f"{what} in a trainer outside a ledger span: a raw "
+                f"device sync stalls dispatch and the stall is "
+                f"invisible to the goodput ledger — wrap it in "
+                f"`with ...span(...)`/`step_span(...)` (or feed the "
+                f"health ledger, which fetches K steps late under "
+                f"data_wait{{site=health}}), or annotate "
+                f"`# lint-obs: ok (<why>)`")
 
 
 class EventKindCollisionRule(Rule):

@@ -29,6 +29,13 @@ from sparktorch_tpu.obs.goodput import (
     LedgerSpan,
     mfu_honest,
 )
+from sparktorch_tpu.obs.health import (
+    HealthConfig,
+    TrainHealthLedger,
+    health_alert_rules,
+    tree_checksum,
+)
+from sparktorch_tpu.obs.replay import load_bundle, replay_bundle
 from sparktorch_tpu.obs.sinks import JsonlSink, read_jsonl, write_jsonl
 from sparktorch_tpu.obs.prom import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
@@ -86,6 +93,12 @@ __all__ = [
     "GoodputLedger",
     "LedgerSpan",
     "mfu_honest",
+    "HealthConfig",
+    "TrainHealthLedger",
+    "health_alert_rules",
+    "tree_checksum",
+    "load_bundle",
+    "replay_bundle",
     "JsonlSink",
     "read_jsonl",
     "write_jsonl",

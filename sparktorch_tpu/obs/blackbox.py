@@ -52,7 +52,7 @@ DEFAULT_PUBLISH_INTERVAL_S = 0.25
 # failure narrative, not per-sample metric noise. A "span" event is a
 # closed Telemetry.span (the worker's own timed regions).
 DEFAULT_KIND_PREFIXES = ("span", "ctl.", "ft_", "alert.", "gang",
-                         "chaos", "profile_trace")
+                         "chaos", "profile_trace", "health")
 
 
 class FlightRecorder:
@@ -276,6 +276,27 @@ def collect_postmortem(out_dir: str, reason: str,
                    or telemetry.get_section(_profile_mod.SECTION))
         if isinstance(section, Mapping):
             profile = dict(section)
+    # And the model-health ledger: "health at death" answers the
+    # question the other two can't — did the NUMBERS go bad before the
+    # process did, and on which rank. Same source order; a bare
+    # composite section is merged to the run shape so the postmortem
+    # renderer sees one document kind.
+    health = None
+    if collector is not None:
+        try:
+            health = collector.health_view()
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            health = None
+    if health is None and telemetry is not None:
+        from sparktorch_tpu.obs import health as _health_mod
+
+        section = telemetry.get_section(_health_mod.RUN_SECTION)
+        if isinstance(section, Mapping):
+            health = dict(section)
+        else:
+            section = telemetry.get_section(_health_mod.SECTION)
+            if isinstance(section, Mapping):
+                health = _health_mod.merge_sections({"local": section})
     # Dedup (the controller's history events also flow through its
     # bus recorder) and order: identical (ts, kind, rank) triples
     # collapse, the narrative reads in time order. The controller's
@@ -312,6 +333,7 @@ def collect_postmortem(out_dir: str, reason: str,
         "metric_deltas": deltas,
         "goodput": goodput,
         "profile": profile,
+        "health": health,
         "rpc_traces": rpc_traces,
         "heartbeats": heartbeats,
         "world": world,

@@ -457,6 +457,7 @@ class FleetCollector:
         self._stitch_rpc()
         self._merge_goodput()
         self._merge_profile()
+        self._merge_health()
         merged = self.merged_snapshot()
         alert_events: List[Dict[str, Any]] = []
         if self.history is not None:
@@ -505,10 +506,27 @@ class FleetCollector:
                         "n_ranks": prof_doc.get("n_ranks"),
                         "bursts": prof_doc.get("bursts"),
                     })
+                # And the model-health merge: one condensed
+                # `health.run` line per sweep (anomaly counts stay
+                # rank-tagged — a single poisoned rank must surface
+                # by name, never averaged into the fleet).
+                health_records: List[Dict[str, Any]] = []
+                health_doc = (merged.get("sections") or {}).get("health_run")
+                if isinstance(health_doc, Mapping):
+                    health_records.append({
+                        "kind": "health.run", "ts": merged.get("ts"),
+                        "n_ranks": health_doc.get("n_ranks"),
+                        "last_step": health_doc.get("last_step"),
+                        "anomalies_total": health_doc.get(
+                            "anomalies_total"),
+                        "counts": health_doc.get("counts"),
+                        "worst": health_doc.get("worst"),
+                    })
                 write_jsonl(self.jsonl_path,
                             [{"kind": f"alert.{e['event']}", **e}
                              for e in alert_events]
                             + goodput_records + profile_records
+                            + health_records
                             + [{"kind": "gang_snapshot", **merged,
                                 "heartbeats": self._merged_heartbeats()}],
                             append=True)
@@ -656,6 +674,39 @@ class FleetCollector:
         from sparktorch_tpu.obs import profile as _profile
 
         doc = self.telemetry.get_section(_profile.RUN_SECTION)
+        return dict(doc) if isinstance(doc, Mapping) else None
+
+    def _merge_health(self) -> None:
+        """Fold every scraped rank's ``health`` ledger section (plus
+        this collector's own bus's, when a driver-side ledger shares
+        it) into one run-level model-health report, published as the
+        ``health_run`` section. The merge is strictly rank-tagged —
+        anomalies carry their source rank and are never averaged, so
+        a single poisoned rank surfaces by name. Last-good contract:
+        a dead rank's final ledger keeps contributing its anomalies."""
+        from sparktorch_tpu.obs import health as _health
+
+        with self._lock:
+            snaps = {r: st.snapshot for r, st in self._ranks.items()}
+        docs = _health.sections_from_snapshots(snaps)
+        own = self.telemetry.get_section(_health.SECTION)
+        if isinstance(own, Mapping):
+            docs.setdefault("collector", own)
+        if not docs:
+            return
+        run = _health.merge_sections(docs)
+        run["run_id"] = self.run_id
+        self.telemetry.set_section(_health.RUN_SECTION, run)
+
+    def health_view(self) -> Optional[Dict[str, Any]]:
+        """The run-level model-health report ``GET /health`` serves —
+        recomputed from the freshest last-good snapshots at read
+        time, like :meth:`goodput_view`. None when no rank has
+        published a health section."""
+        self._merge_health()
+        from sparktorch_tpu.obs import health as _health
+
+        doc = self.telemetry.get_section(_health.RUN_SECTION)
         return dict(doc) if isinstance(doc, Mapping) else None
 
     # -- merged views ------------------------------------------------------
@@ -1088,6 +1139,17 @@ class FleetCollector:
                             self._send(404, json.dumps(
                                 {"ok": False,
                                  "error": "no stack profile published "
+                                          "by any scraped rank"}).encode(),
+                                content_type="application/json")
+                        else:
+                            self._send(200, json.dumps(doc).encode(),
+                                       content_type="application/json")
+                    elif route == "/health":
+                        doc = collector.health_view()
+                        if doc is None:
+                            self._send(404, json.dumps(
+                                {"ok": False,
+                                 "error": "no health ledger published "
                                           "by any scraped rank"}).encode(),
                                 content_type="application/json")
                         else:

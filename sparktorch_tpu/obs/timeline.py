@@ -427,6 +427,130 @@ def _goodput_from_jsonl(records: List[Dict[str, Any]]
 
 
 # ---------------------------------------------------------------------------
+# Model-health rendering (per-rank sparklines + anomaly log)
+# ---------------------------------------------------------------------------
+
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[Any], width: int = _BAR_W) -> str:
+    """A unicode sparkline over the last ``width`` values; non-finite
+    points render as ``!`` — the whole point of the health view is
+    that a NaN must be VISIBLE, not interpolated away."""
+    import math
+
+    vals = []
+    for v in values[-width:]:
+        try:
+            vals.append(float(v))
+        except (TypeError, ValueError):
+            vals.append(float("nan"))
+    if not vals:
+        return ""
+    finite = [v for v in vals if math.isfinite(v)]
+    lo = min(finite) if finite else 0.0
+    hi = max(finite) if finite else 0.0
+    span = (hi - lo) or 1.0
+    out = []
+    for v in vals:
+        if not math.isfinite(v):
+            out.append("!")
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_GLYPHS) - 1))
+            out.append(_SPARK_GLYPHS[max(0, min(idx,
+                                                len(_SPARK_GLYPHS) - 1))])
+    return "".join(out)
+
+
+def render_health_report(doc: Dict[str, Any], top: int = 10) -> str:
+    """One terminal page from a run-level model-health report (the
+    collector's ``GET /health`` document, or a single rank's
+    ``health`` section merged to the same shape): the run summary
+    with per-kind anomaly counts, one loss + grad-norm sparkline pair
+    per rank (NaNs render as ``!``), then the recent anomaly log —
+    every line rank-tagged, never a fleet average."""
+    per_rank = doc.get("per_rank")
+    if not isinstance(per_rank, dict) or not per_rank:
+        per_rank = {str(doc.get("rank", "?")): doc}
+    counts = doc.get("counts") or {}
+    total = int(doc.get("anomalies_total") or 0)
+    lines = [
+        f"model health: {doc.get('n_ranks', len(per_rank))} ranks, "
+        f"{doc.get('steps_total', 0)} steps ingested, "
+        f"last step {doc.get('last_step', -1)}"
+        + (f"   run: {doc['run_id']}" if doc.get("run_id") else ""),
+        "anomalies: "
+        + (", ".join(f"{k}={counts[k]}" for k in sorted(counts)
+                     if counts[k]) or "none")
+        + (f"  (total {total})" if total else ""),
+    ]
+    worst = doc.get("worst")
+    if isinstance(worst, dict):
+        lines.append(
+            f"worst: {worst.get('akind')} @ step {worst.get('step')} "
+            f"rank {worst.get('rank')} value={worst.get('value')}")
+    lines += ["", f"{'rank':>10} {'step':>7} {'last loss':>12}  "
+                  f"loss / grad-norm (! = non-finite)"]
+
+    def _rank_key(item):
+        try:
+            return (0, int(item[0]))
+        except (TypeError, ValueError):
+            return (1, str(item[0]))
+
+    for rank, rdoc in sorted(per_rank.items(), key=_rank_key):
+        series = rdoc.get("series") or {}
+        last = rdoc.get("last") or {}
+        loss = last.get("loss")
+        loss_s = (f"{float(loss):.5g}"
+                  if isinstance(loss, (int, float)) else "?")
+        lines.append(
+            f"{str(rank):>10} {rdoc.get('last_step', -1):>7}"
+            f" {loss_s:>12}  {_sparkline(series.get('loss') or [])}")
+        gn = series.get("grad_norm") or []
+        if gn:
+            lines.append(f"{'':>10} {'':>7} {'':>12}  {_sparkline(gn)}")
+        leaves = rdoc.get("top_grad_leaves") or []
+        if leaves:
+            lines.append(
+                f"{'':>10} {'':>7} {'':>12}  top grad leaves: "
+                + ", ".join(f"{k}={float(v):.3g}"
+                            for k, v in leaves[:3]))
+    anomalies = doc.get("anomalies") or []
+    if anomalies:
+        lines += ["", f"recent anomalies (last {min(len(anomalies), top)}):"]
+        for a in anomalies[-top:]:
+            lines.append(
+                f"  step {a.get('step'):>6}  rank {a.get('rank')!s:<6}"
+                f" {a.get('akind'):<14} value={a.get('value')}"
+                f" threshold={a.get('threshold')}"
+                f" lag={a.get('detect_lag')}")
+    return "\n".join(lines) + "\n"
+
+
+def _health_from_jsonl(records: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """The newest model-health doc in a JSONL file: a collector
+    sink/dump record carrying the merged ``health_run`` section wins;
+    a bare rank dump's composite ``health`` section is merged to the
+    same shape so one renderer serves both."""
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        doc = sections.get("health_run")
+        if isinstance(doc, dict) and doc.get("per_rank"):
+            return doc
+    from sparktorch_tpu.obs import health as _health
+
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        sec = sections.get("health")
+        if isinstance(sec, dict) and (sec.get("ranks") or sec.get("rank")):
+            return _health.merge_sections({"dump": sec})
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Stack-profile rendering (per-bucket top-down trees)
 # ---------------------------------------------------------------------------
 
@@ -649,6 +773,27 @@ def render_postmortem_report(doc: Dict[str, Any], top: int = 40) -> str:
                 continue
             lines.append(f"  {bucket:<18} {n:>6} samples"
                          f"  hot: {hot[0][0]} [self {hot[0][1]}]")
+    hdoc = doc.get("health")
+    if isinstance(hdoc, dict) and (hdoc.get("per_rank")
+                                   or hdoc.get("anomalies")):
+        counts = hdoc.get("counts") or {}
+        lines.append("")
+        lines.append(
+            f"model health at death: "
+            + (", ".join(f"{k}={counts[k]}" for k in sorted(counts)
+                         if counts[k]) or "no anomalies")
+            + f" over {hdoc.get('n_ranks', '?')} rank(s), last step "
+            f"{hdoc.get('last_step', -1)}")
+        worst = hdoc.get("worst")
+        if isinstance(worst, dict):
+            lines.append(
+                f"  worst: {worst.get('akind')} @ step "
+                f"{worst.get('step')} rank {worst.get('rank')} "
+                f"value={worst.get('value')}")
+        for a in (hdoc.get("anomalies") or [])[-4:]:
+            lines.append(
+                f"  step {a.get('step'):>6}  rank {a.get('rank')!s:<6}"
+                f" {a.get('akind')} value={a.get('value')}")
     traces = doc.get("rpc_traces") or []
     if traces:
         lines.append("")
@@ -720,7 +865,7 @@ class FollowReader:
 # Record kinds --follow renders (everything else is metric volume the
 # tail mode exists to cut through). "span" is deliberately absent.
 _FOLLOW_PREFIXES = ("alert.", "ctl.", "ft_", "chaos", "gang_snapshot",
-                    "goodput", "profile")
+                    "goodput", "profile", "health")
 
 
 def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
@@ -765,6 +910,20 @@ def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
                    if thief else "")
                 + (f" comm={rec['comm_source']}"
                    if rec.get("comm_source") else ""))
+    if kind == "health.run":
+        # The collector's condensed model-health record: one line says
+        # whether the numerics are clean NOW and, if not, names the
+        # worst anomaly with its source rank.
+        worst = rec.get("worst") or {}
+        n_anom = int(rec.get("anomalies_total") or 0)
+        return (f"{stamp}  {kind:<18} "
+                f" ranks={rec.get('n_ranks')}"
+                f" step={rec.get('last_step')}"
+                f" anomalies={n_anom}"
+                + (f" worst={worst.get('akind')}"
+                   f"@step{worst.get('step')}"
+                   f" rank={worst.get('rank')}"
+                   if worst else ""))
     who = ""
     if rec.get("rank") is not None:
         who = f" rank={rec['rank']}"
@@ -1011,6 +1170,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "collector/telemetry .jsonl carrying the "
                              "profile_run/profile section): per-bucket "
                              "top-down trees, hottest frame named")
+    parser.add_argument("--health", action="store_true",
+                        help="render a run-level model-health report "
+                             "(a saved GET /health document, or a "
+                             "collector/telemetry .jsonl carrying the "
+                             "health_run/health section): per-rank "
+                             "loss/grad-norm sparklines, rank-tagged "
+                             "anomaly log, worst anomaly named")
     parser.add_argument("--diff", metavar="PRIOR", default=None,
                         help="with --profile: compare against a prior "
                              "profile document/JSONL and render the "
@@ -1030,15 +1196,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.top = 40 if args.postmortem else 10
 
     if sum((args.gang, args.tune, args.rpc, args.postmortem,
-            args.follow, args.goodput, args.profile)) > 1:
+            args.follow, args.goodput, args.profile, args.health)) > 1:
         print("error: --gang, --tune, --rpc, --postmortem, --follow, "
-              "--goodput and --profile are different reports; pick one")
+              "--goodput, --profile and --health are different reports; "
+              "pick one")
         return 2
     if args.diff is not None and not args.profile:
         print("error: --diff goes with --profile")
         return 2
     if args.profile:
         return _main_profile(args)
+    if args.health:
+        return _main_health(args)
     if args.goodput:
         return _main_goodput(args)
     if args.tune:
@@ -1153,6 +1322,44 @@ def _main_goodput(args) -> int:
                   f"(no buckets)")
             return 1
     print(json.dumps(doc) if args.json else render_goodput_report(doc),
+          end="" if not args.json else "\n")
+    return 0
+
+
+def _main_health(args) -> int:
+    """--health: a saved /health JSON document, or a JSONL whose
+    newest record carries the health_run (collector) / health
+    (single rank) section."""
+    if len(args.paths) > 1:
+        print("error: --health renders one file at a time")
+        return 2
+    path = args.paths[0]
+    if _looks_like_jsonl(path):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(path)
+        except OSError as e:
+            print(f"error: {e}")
+            return 1
+        doc = _health_from_jsonl(records)
+        if doc is None:
+            print(f"no model-health ledger (sections.health_run / "
+                  f"sections.health) in {path}")
+            return 1
+    else:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}")
+            return 1
+        if not isinstance(doc, dict) or doc.get("kind") != "health_run":
+            print(f"error: {path} is not a health document "
+                  f"(kind != 'health_run')")
+            return 1
+    print(json.dumps(doc) if args.json
+          else render_health_report(doc, top=args.top),
           end="" if not args.json else "\n")
     return 0
 

@@ -40,6 +40,7 @@ import numpy as np
 
 from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.obs import goodput as _goodput
+from sparktorch_tpu.obs import health as _health
 from sparktorch_tpu.net.transport import BinaryTransport
 from sparktorch_tpu.obs import get_logger, get_telemetry
 from sparktorch_tpu.serve.param_server import ParameterServer, ParamServerHttp
@@ -364,6 +365,14 @@ def _worker_loop(
     tele = telemetry or get_telemetry()
     log = get_logger("sparktorch_tpu.train.hogwild")
     labels = {"worker": worker_id}
+    # Per-WORKER health ledger on the shared bus: each worker's loss
+    # series and anomalies stay tagged with its own rank ("w<id>") in
+    # the composite health section — a NaN on one worker must surface
+    # as that worker's NaN, never fleet-averaged. Device losses are
+    # queued un-synced; the K-late drain materializes windows whose
+    # compute long finished, preserving the async dispatch pipeline.
+    hl = (_health.TrainHealthLedger(rank=f"w{worker_id}", telemetry=tele)
+          if _health.enabled() else None)
     try:
         if hasattr(transport, "stats"):
             # Fresh per-round stats: the transport object survives
@@ -391,6 +400,9 @@ def _worker_loop(
             # worker at step N (ChaosKill lands in `errors` like any
             # real failure; under supervision it triggers a restart).
             _chaos.fire("worker.step", worker=worker_id, step=it)
+            _act = _chaos.fire("data.batch", worker=worker_id, step=it)
+            if _act and _act.get("poison"):
+                shard = _chaos.poison_batch(shard)
             # Wire waits are EXPOSED comm by definition (nothing
             # overlaps them in this loop); the pulled params' host->
             # device upload is a data wait. Both ride LedgerSpans so
@@ -444,6 +456,8 @@ def _worker_loop(
             tele.counter("hogwild.pushes", labels=labels)
             tele.gauge("hogwild.pulled_version", have_version, labels=labels)
             pending.append((it, k, have_version, losses, time.perf_counter()))  # lint-obs: ok (throughput timestamp)
+            if hl is not None:
+                hl.note_step(step=it, count=k, device={"loss": losses})
             it += k
             if verbose:
                 last = jnp.reshape(jnp.asarray(losses), (-1,))[-1]
@@ -476,6 +490,8 @@ def _worker_loop(
         records.extend(done)
         # The drain is where the async windows' device compute lands.
         _goodput.add("compute", time.perf_counter() - t_drain0)  # lint-obs: ok (phase stats pair, feeds the ledger)
+        if hl is not None:
+            hl.flush()
         if phase_out is not None:
             st = dict(getattr(transport, "stats", {}) or {})
             st.update({
@@ -822,8 +838,11 @@ def train_async(
                 break
 
         params, model_state = server.final_state()
+        # The worker pool is joined; there is no dispatch pipeline
+        # left to stall.
+        # lint-obs: ok (end-of-run gather)
         params = jax.device_get(params)
-        model_state = jax.device_get(model_state)
+        model_state = jax.device_get(model_state)  # lint-obs: ok (end-of-run)
         summary = None
         if phase_stats:
             # The budget that sums to the whole: per-phase seconds

@@ -2373,6 +2373,9 @@ def make_pp_train_step(
                 # state.step instead of a stale cache (ADVICE r04).
                 if ("host_step" not in cache
                         or state.step is not cache.get("last_step_arr")):
+                    # One scalar, only on resume/cache invalidation —
+                    # steady state uses the host mirror.
+                    # lint-obs: ok (resume-only scalar)
                     cache["host_step"] = int(jax.device_get(state.step))
                 key = jax.random.fold_in(
                     jax.random.key(0), cache["host_step"]
@@ -2390,6 +2393,7 @@ def make_pp_train_step(
             # participant at an 8-thread collective permute, or a
             # cross-collective deadlock). The virtual-device test rig
             # serializes executions instead; real TPU stays async.
+            # lint-obs: ok (deliberate CPU-only rendezvous serialization)
             jax.block_until_ready((new_params, new_opt, loss))
         new_state = PipelineState(step=state.step + K, params=new_params,
                                   opt_state=new_opt)
@@ -2550,9 +2554,14 @@ def train_distributed_pipeline(
     tele = telemetry or get_telemetry()
     log = get_logger("sparktorch_tpu.train")
     # Stack sampler beside the ambient ledger (see train/sync.py).
+    from sparktorch_tpu.ft import chaos as _chaos
+    from sparktorch_tpu.obs import health as _health
     from sparktorch_tpu.obs import profile as _profile
 
     _profile.ensure(tele)
+    _hl = _health.ensure(tele, rank=jax.process_index())
+    if _hl is not None:
+        _hl.reset()
 
     module = spec.make_module()
     if isinstance(module, CausalLM):
@@ -2785,8 +2794,9 @@ def train_distributed_pipeline(
     )
     recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele,
                                prefix="train_pp")
+    # lint-obs: ok (two scalars before the loop starts — nothing queued)
     last_ckpt = int(jax.device_get(state.step)) if ckpt is not None else 0
-    start = int(jax.device_get(state.step))
+    start = int(jax.device_get(state.step))  # lint-obs: ok (pre-loop scalar)
     # Seed folded with the restored step: a resumed run must draw
     # FRESH permutations, not replay the interrupted run's (same
     # invariant as the streaming trainer's resume seeding).
@@ -2827,6 +2837,10 @@ def train_distributed_pipeline(
                 # heartbeat so the driver can read cross-rank skew.
                 check_gang()
                 notify_gang_step(i)
+                _act = _chaos.fire("data.batch",
+                                   worker=jax.process_index(), step=i)
+                if _act and _act.get("poison"):
+                    batch = _chaos.poison_batch(batch)
                 sample_key, sub = jax.random.split(sample_key)
                 # Goodput step clock: dispatch + loss materialization
                 # timed by a LedgerSpan (step_time_s comes off its
@@ -2869,6 +2883,15 @@ def train_distributed_pipeline(
                     )
                 eval_s = _eval_led.duration_s
                 dt = _led.duration_s / len(losses)
+                if _hl is not None:
+                    # Loss/grad-norm are already host floats here (the
+                    # step call materializes them); the ledger still
+                    # applies its detectors on the K-late cadence.
+                    _hl.note_step(count=len(losses),
+                                  host={"loss": np.asarray(losses),
+                                        "grad_norm": np.asarray(
+                                            [g if g is not None else np.nan
+                                             for g in gnorms])})
                 for j, (l, g, e, dr) in enumerate(
                     zip(losses, gnorms, exs, drops)
                 ):
@@ -2915,6 +2938,8 @@ def train_distributed_pipeline(
                 break
         completed = True
     finally:
+        if _hl is not None:
+            _hl.flush()
         profiler.__exit__(None, None, None)
         _finalize_checkpoint(ckpt, state, completed)
 
@@ -2930,9 +2955,10 @@ def train_distributed_pipeline(
             out_shardings=jax.tree.map(lambda _: _replicated(mesh),
                                        state.params),
         )
+        # lint-obs: ok (end-of-run gather after the loop drained)
         trained = jax.device_get(gather(state.params))
     else:
-        trained = jax.device_get(state.params)
+        trained = jax.device_get(state.params)  # lint-obs: ok (end-of-run)
     if interleaved:
         trained = apply_interleave_permutation(
             trained, cfg, mesh.shape[AXIS_PP], virtual_stages,

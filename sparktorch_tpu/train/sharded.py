@@ -27,6 +27,7 @@ from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
 from sparktorch_tpu.parallel.mesh import AXIS_SP, BATCH_AXES, replicated
 from sparktorch_tpu.parallel.sharding_rules import shard_params, transformer_rules
 from sparktorch_tpu.train.step import (
+    HealthVec,
     StepMetrics,
     TrainState,
     _accepts_example_w,
@@ -472,10 +473,24 @@ def make_sharded_train_step(
         )
         # GSPMD computes over GLOBAL arrays, so the sown counters are
         # already global sums — no extra collective needed.
+        gnorm = optax.global_norm(grads)
+        grad_leaves = jax.tree.leaves(grads)
+        leaf_norms = (
+            jnp.stack([jnp.sqrt(jnp.sum(jnp.square(g))).astype(jnp.float32)
+                       for g in grad_leaves])
+            if grad_leaves else jnp.zeros((0,), jnp.float32)
+        )
         metrics = StepMetrics(
-            loss=loss, examples=den, grad_norm=optax.global_norm(grads),
+            loss=loss, examples=den, grad_norm=gnorm,
             drop_fraction=(drops[0] / jnp.maximum(drops[1], 1.0)
                            if drops is not None else None),
+            health=HealthVec(
+                finite=(jnp.isfinite(loss)
+                        & jnp.isfinite(gnorm)).astype(jnp.float32),
+                update_ratio=optax.global_norm(updates)
+                / jnp.maximum(optax.global_norm(new_params), 1e-12),
+                leaf_norms=leaf_norms,
+            ),
         )
         return new_state, metrics
 
@@ -493,6 +508,7 @@ def make_sharded_train_step(
 
     from sparktorch_tpu.obs import get_telemetry
     from sparktorch_tpu.obs import goodput as _goodput
+    from sparktorch_tpu.obs import health as _health
     from sparktorch_tpu.obs import profile as _stackprof
     from sparktorch_tpu.utils.tracing import profile_run, step_annotation
 
@@ -501,6 +517,22 @@ def make_sharded_train_step(
     # the caller owns the loop here, so the step factory is where
     # "wherever ledgers live" lands for the GSPMD path.
     _stackprof.ensure(tele)
+    _health.ensure(tele)
+
+    def _feed_health(out) -> None:
+        # Everything queues as DEVICE values (including loss/grad_norm
+        # — this path never host-syncs them itself); the ledger's
+        # K-late drain does the one attributed readback.
+        hl = _health.active()
+        if hl is None:
+            return
+        m = out[1]
+        dev = {"loss": m.loss, "grad_norm": m.grad_norm}
+        if m.health is not None:
+            dev.update(finite=m.health.finite,
+                       update_ratio=m.health.update_ratio,
+                       leaf_norms=m.health.leaf_norms)
+        hl.note_step(device=dev)
     loop_state = {"calls": 0, "profiler": None, "handle": None}
     # The comm model the goodput ledger starts under: the tuner's
     # measured exposed fraction for the winning mesh when the auto
@@ -524,7 +556,9 @@ def make_sharded_train_step(
         if ledger is None:
             with _set_mesh(mesh), tele.span("train_sharded/step"), \
                     step_annotation(step_no, telemetry=tele):
-                return jitted(state, batch)
+                out = jitted(state, batch)
+            _feed_health(out)
+            return out
         # Ledger-armed path: the call is timed as a step span, synced
         # (async dispatch without a sync measures enqueue, not compute
         # — the ROUND4 honest-timing lesson), and re-bucketed to
@@ -553,6 +587,7 @@ def make_sharded_train_step(
                 # artifact was stamped from.
                 tune_result.compile_count += 1
                 tune_result.compile_s_total += float(led.duration_s)
+        _feed_health(out)
         return out
 
     # Introspection hooks (tests assert on the compiled HLO — e.g. that

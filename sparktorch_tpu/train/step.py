@@ -69,6 +69,22 @@ class TrainState(NamedTuple):
     rng: jax.Array
 
 
+class HealthVec(NamedTuple):
+    """On-device model-health vector, computed inside the jitted step
+    (obs/health.py's TrainHealthLedger fetches it asynchronously K
+    steps late — nothing here may force a host sync).
+
+    ``finite`` is 1.0 iff loss and the global grad-norm are both
+    finite (the grad-norm is a sum of squares, so any NaN/Inf grad
+    leaf poisons it — one bit covers the whole tree). ``leaf_norms``
+    is the per-leaf grad-norm vector in tree-flatten order; the key
+    table lives host-side (health.health_leaf_keys)."""
+
+    finite: jax.Array        # f32 scalar, 1.0 = all finite
+    update_ratio: jax.Array  # ||update|| / ||new params||
+    leaf_norms: jax.Array    # f32[n_leaves]
+
+
 class StepMetrics(NamedTuple):
     loss: jax.Array        # global weighted-mean train loss
     examples: jax.Array    # real (weight>0) examples this step, global
@@ -76,6 +92,7 @@ class StepMetrics(NamedTuple):
     # Fraction of routed MoE token-choices dropped at expert capacity
     # (global); None (empty pytree leaf) for models without MoE.
     drop_fraction: Optional[jax.Array] = None
+    health: Optional[HealthVec] = None
 
 
 class EpochMetrics(NamedTuple):
@@ -90,6 +107,7 @@ class EpochMetrics(NamedTuple):
     val_loss: jax.Array
     active: jax.Array
     drop_fraction: Optional[jax.Array] = None
+    health: Optional[HealthVec] = None
 
 
 class EsConfig(NamedTuple):
@@ -331,6 +349,21 @@ def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
     new_params = optax.apply_updates(state.params, updates)
     gnorm = optax.global_norm(grads)
 
+    # Model-health vector (obs/health.py): tiny fused reductions, no
+    # extra collectives — grads are already globally psum'd above.
+    grad_leaves = jax.tree.leaves(grads)
+    leaf_norms = (
+        jnp.stack([jnp.sqrt(jnp.sum(jnp.square(g))).astype(jnp.float32)
+                   for g in grad_leaves])
+        if grad_leaves else jnp.zeros((0,), jnp.float32)
+    )
+    health = HealthVec(
+        finite=(jnp.isfinite(loss) & jnp.isfinite(gnorm)).astype(jnp.float32),
+        update_ratio=optax.global_norm(updates)
+        / jnp.maximum(optax.global_norm(new_params), 1e-12),
+        leaf_norms=leaf_norms,
+    )
+
     new_state = TrainState(
         step=state.step + 1,
         params=new_params,
@@ -339,7 +372,7 @@ def _dp_body(apply_fn, loss_fn, tx, axis_names, per_shard_mb,
         rng=next_rng,
     )
     return new_state, StepMetrics(loss=loss, examples=den_g, grad_norm=gnorm,
-                                  drop_fraction=drop_fraction)
+                                  drop_fraction=drop_fraction, health=health)
 
 
 def make_train_step(
@@ -509,6 +542,7 @@ def make_train_epoch_fused(
                 val_loss=val,
                 active=active,
                 drop_fraction=metrics.drop_fraction,
+                health=metrics.health,
             )
             return (new_state, new_es), out
 

@@ -111,6 +111,7 @@ def _save_if_due(ckpt, state, last_ckpt_step: int, every: int) -> int:
     the save. Returns the (possibly advanced) last-saved step."""
     if ckpt is None or every <= 0:
         return last_ckpt_step
+    # lint-obs: ok (one scalar at checkpoint cadence, not per step)
     step_now = int(jax.device_get(state.step))
     if step_now - last_ckpt_step >= every:
         ckpt.save(step_now, state)
@@ -147,6 +148,7 @@ def _finalize_checkpoint(ckpt, state, completed: bool) -> None:
     if ckpt is None:
         return
     if completed:
+        # lint-obs: ok (end-of-run scalar, the loop already drained)
         final_step = int(jax.device_get(state.step))
         if ckpt.latest_step() != final_step:
             ckpt.save(final_step, state, force=True)
@@ -230,9 +232,17 @@ def train_distributed(
     # The continuous stack sampler lives wherever ledgers live: the
     # ambient ledger names the thieving bucket, the sampler names the
     # function inside it. Env-gated; idempotent per process.
+    from sparktorch_tpu.obs import health as _health
     from sparktorch_tpu.obs import profile as _profile
 
     _profile.ensure(tele)
+    # Model-health lane (obs/health.py): per-rank ledger fed each step
+    # with device values fetched K steps late. reset() re-bases the
+    # EWMAs so a restarted attempt on the same bus is not judged
+    # against the previous attempt's loss baseline.
+    _hl = _health.ensure(tele, rank=jax.process_index())
+    if _hl is not None:
+        _hl.reset()
     if pre_sharded:
         # ``data`` is already a globally-sharded DataBatch (multi-host
         # path, train_distributed_multihost) — do not re-place it.
@@ -276,6 +286,8 @@ def train_distributed(
         )()
 
     ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
+    if _hl is not None and _hl.leaf_keys is None:
+        _hl.leaf_keys = _health.health_leaf_keys(state.params)
 
     loss_fn = spec.loss_fn()
     module = spec.make_module()
@@ -338,6 +350,7 @@ def train_distributed(
     recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele)
     metrics = recorder.records
     log = get_logger("sparktorch_tpu.train")
+    # lint-obs: ok (pre-loop scalar — nothing queued yet)
     last_ckpt_step = int(jax.device_get(state.step)) if ckpt is not None else 0
     shuffle_key = jax.random.key(seed + 1)
     profiler = profile_run(profile_dir, telemetry=tele)
@@ -376,6 +389,17 @@ def train_distributed(
                 # irrelevant across resumes.
                 _chaos.fire("worker.step", worker=jax.process_index(),
                             step=i)
+                # Seeded poison-batch injection (bench-health drill):
+                # the site returns an action dict instead of raising,
+                # and the poisoned copy REPLACES the resident batch so
+                # the health ledger's replay anchor records exactly
+                # what dispatches.
+                _act = _chaos.fire("data.batch",
+                                   worker=jax.process_index(), step=i)
+                if _act and _act.get("poison"):
+                    train_batch = _chaos.poison_batch(train_batch)
+                if _hl is not None:
+                    _hl.note_replay_anchor(state, train_batch)
                 # The step clock is a goodput LedgerSpan: it times the
                 # dispatch+sync region whether or not a ledger is
                 # active (step_time_s comes off its duration), and when
@@ -422,6 +446,17 @@ def train_distributed(
                                 or cache0) > cache0:
                             _led.rebucket("compile")
                     dt = _led.duration_s / max(1, n_active)
+                    if _hl is not None and n_active > 0:
+                        _h = stacked.health
+                        _hl.note_step(
+                            count=n_active,
+                            device=None if _h is None else {
+                                "finite": _h.finite,
+                                "update_ratio": _h.update_ratio,
+                                "leaf_norms": _h.leaf_norms,
+                            },
+                            host={"loss": losses, "grad_norm": gnorms},
+                        )
                     chunk = [
                         (float(l), float(e), float(g),
                          None if v is None or np.isnan(v) else float(v),
@@ -457,6 +492,17 @@ def train_distributed(
                         if step_metrics.drop_fraction is not None else None,
                     )]
                     dt = _led.duration_s
+                    if _hl is not None:
+                        _h = step_metrics.health
+                        _hl.note_step(
+                            device=None if _h is None else {
+                                "finite": _h.finite,
+                                "update_ratio": _h.update_ratio,
+                                "leaf_norms": _h.leaf_norms,
+                            },
+                            host={"loss": chunk[0][0],
+                                  "grad_norm": chunk[0][2]},
+                        )
 
                 for loss, examples_n, gnorm, val_loss, active, drop_f in chunk:
                     if not active:
@@ -497,6 +543,7 @@ def train_distributed(
                             stop = True
                             break
                     i += 1
+                # lint-obs: ok (one early-stop scalar per drained chunk)
                 if fused_signals and bool(jax.device_get(es_state.stopped)):
                     stop = True
                 if ckpt is not None:
@@ -514,10 +561,15 @@ def train_distributed(
         # check_gang, a raising metrics_hook): close the profiler
         # trace and flush async checkpoint writes already in flight.
         profiler.__exit__(None, None, None)
+        if _hl is not None:
+            # Drain the delayed-fetch tail so the published section
+            # (and any postmortem) reflects the final steps.
+            _hl.flush()
         _finalize_checkpoint(ckpt, state, completed)
 
+    # lint-obs: ok (end-of-run gather after the loop drained)
     params = jax.device_get(state.params)
-    model_state = jax.device_get(state.model_state)
+    model_state = jax.device_get(state.model_state)  # lint-obs: ok (end-of-run)
     return TrainResult(params=params, model_state=model_state, metrics=metrics,
                        spec=spec, summary=recorder.summary())
 
@@ -783,14 +835,21 @@ def train_distributed_streaming(
     from sparktorch_tpu.utils.metrics import MetricsRecorder
 
     ckpt, state = _open_checkpoint(checkpoint_dir, resume, state)
+    # lint-obs: ok (pre-loop scalar — nothing queued yet)
     last_ckpt_step = int(jax.device_get(state.step)) if ckpt is not None else 0
 
     tele = telemetry or get_telemetry()
     log = get_logger("sparktorch_tpu.train")
     # Stack sampler beside the ambient ledger (see train_distributed).
+    from sparktorch_tpu.obs import health as _health
     from sparktorch_tpu.obs import profile as _profile
 
     _profile.ensure(tele)
+    _hl = _health.ensure(tele, rank=jax.process_index())
+    if _hl is not None:
+        _hl.reset()
+        if _hl.leaf_keys is None:
+            _hl.leaf_keys = _health.health_leaf_keys(state.params)
     recorder = MetricsRecorder(n_chips=mesh.size, telemetry=tele,
                                prefix="train_streaming")
     # Fold the restored step into the shuffle seed: a resumed run must
@@ -814,6 +873,13 @@ def train_distributed_streaming(
                 # compiled dispatch, not at the epoch boundary.
                 check_gang()
                 notify_gang_step(it_counter)
+                _act = _chaos.fire("data.batch",
+                                   worker=jax.process_index(),
+                                   step=it_counter)
+                if _act and _act.get("poison"):
+                    resident = _chaos.poison_batch(resident)
+                if _hl is not None:
+                    _hl.note_replay_anchor(state, resident)
                 cache0 = (_goodput.jit_cache_size(step_fn)
                           if _goodput.active() is not None else None)
                 with _goodput.step_span() as _led, \
@@ -838,6 +904,20 @@ def train_distributed_streaming(
                         _led.rebucket("compile")
                 examples = np.asarray(metrics.examples).reshape(-1)
                 dt = _led.duration_s / len(losses)
+                if _hl is not None:
+                    _h = metrics.health
+                    _hl.note_step(
+                        count=len(losses),
+                        device=None if _h is None else {
+                            "finite": _h.finite,
+                            "update_ratio": _h.update_ratio,
+                            "leaf_norms": _h.leaf_norms,
+                        },
+                        host={"loss": losses,
+                              "grad_norm": np.asarray(
+                                  metrics.grad_norm).reshape(
+                                      losses.shape[0], -1)[:, 0]},
+                    )
                 for j in range(len(losses)):
                     record = {
                         "round": epoch, "iter": it_counter,
@@ -860,9 +940,12 @@ def train_distributed_streaming(
                              f"loss {losses[-1]:.6f}")
         completed = True
     finally:
+        if _hl is not None:
+            _hl.flush()
         _finalize_checkpoint(ckpt, state, completed)
+    # lint-obs: ok (end-of-run gather after the loop drained)
     params = jax.device_get(state.params)
-    model_state = jax.device_get(state.model_state)
+    model_state = jax.device_get(state.model_state)  # lint-obs: ok (end-of-run)
     return TrainResult(params=params, model_state=model_state,
                        metrics=recorder.records, spec=spec,
                        summary=recorder.summary())

@@ -1,0 +1,18 @@
+"""SPK108 true negatives: the same syncs are fine when the stall is
+attributed — inside a ledger span (goodput .span / .step_span), so
+the wait lands in a named bucket instead of vanishing."""
+
+import jax
+
+
+def drain_metrics(goodput, ledger, out):
+    with goodput.span("data_wait", {"site": "health"}):
+        host = jax.device_get(out)
+    with ledger.step_span(1):
+        out.block_until_ready()
+        host2 = jax.block_until_ready(out)
+    with goodput.span("ckpt"):
+        # Nested statements inside the span body still count.
+        if host is not None:
+            jax.device_get(host)
+    return host, host2

@@ -1,0 +1,18 @@
+"""SPK108 true positives: raw device syncs a trainer would do
+outside any ledger span — each one stalls async dispatch and hides
+the stall from the goodput accounting."""
+
+import jax
+from jax import device_get as dg
+
+
+def drain_metrics(out):
+    # Bare module-path readback.
+    host = jax.device_get(out)
+    # Aliased import resolves to the same call.
+    host2 = dg(out)
+    # Method-form sync on an array.
+    out.block_until_ready()
+    # Explicit module form.
+    jax.block_until_ready(out)
+    return host, host2

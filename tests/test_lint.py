@@ -51,6 +51,7 @@ TRUE_POSITIVES = {
     "obs_misc_tp.py": {"SPK101": 1, "SPK102": 1, "SPK103": 1,
                        "SPK104": 1, "SPK105": 1},
     "profiler_api_tp.py": {"SPK107": 3},
+    "async_fetch_tp.py": {"SPK108": 4},
 }
 
 TRUE_NEGATIVES = [
@@ -62,6 +63,7 @@ TRUE_NEGATIVES = [
     "collective_tn.py",
     "obs_misc_tn.py",
     "profiler_api_tn.py",
+    "async_fetch_tn.py",
     "suppressed_ok.py",
 ]
 
