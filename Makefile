@@ -8,8 +8,8 @@ PYTHON ?= python
 	bench-wire bench-chaos bench-chaos-soak bench-trace bench-gang-obs \
 	bench-ps-fleet bench-tune bench-pp-tune bench-rpc-trace \
 	bench-serve bench-elastic bench-obs-history bench-moe \
-	bench-goodput bench-profile bench-health bench-lint cluster-up \
-	clean lint lint-obs
+	bench-goodput bench-profile bench-health bench-skew bench-lint \
+	cluster-up clean lint lint-obs
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -294,6 +294,25 @@ bench-profile:
 bench-health:
 	$(PYTHON) -m sparktorch_tpu.bench --config health \
 		--log benchmarks/bench_r15_health.jsonl
+
+# Cross-rank step-skew gate: a seeded 0.3s/step straggler on rank 1
+# (ChaosConfig.slow_rank_s, fired before the collective fence) must
+# land >= 80% of the injected seconds in the merged `GET /skew`
+# document's straggler_wait_s, charged to rank 1, with the
+# persistent-laggard verdict naming rank 1 and a cause hypothesis; the
+# sustained skew_straggler_sustained alert latches exactly one episode
+# and reaches an ElasticController as a ctl.scale_signal; an identical
+# A/A fence leg (no chaos) must decompose to ~0 straggler wait with
+# ZERO alert episodes; the per-step boundary stamp must cost < 1% of a
+# training-representative step wall; `timeline --skew` must render the
+# verdict from both the collector sink and a saved document — FAILS
+# otherwise. The record is retained (--log) so the stamp-cost drift
+# gate arms against the windowed median of prior rounds
+# (SPARKTORCH_TPU_SKEW_DRIFT_TOL, relative, default 0.5). Runs on any
+# backend (JAX_PLATFORMS=cpu works).
+bench-skew:
+	$(PYTHON) -m sparktorch_tpu.bench --config skew \
+		--log benchmarks/bench_r16_skew.jsonl
 
 clean:
 	rm -rf build dist *.egg-info sparktorch_tpu/native/_build

@@ -4491,6 +4491,269 @@ def bench_health(poison_step: int = 6, iters: int = 12,
     }
 
 
+def bench_skew(steps: int = 8, delay_s: float = 0.3,
+               from_step: int = 2, stamp_iters: int = 4000) -> dict:
+    """Cross-rank step-skew gate (``make bench-skew``) — FAILS (raises)
+    unless the skew lane's claims hold end to end:
+
+    - **decomposition is real and lands on the right rank**: a seeded
+      ``delay_s``/step straggler on rank 1 (``ChaosConfig.slow_rank_s``,
+      fired inside the step loop BEFORE the collective fence) shows up
+      in the merged ``GET /skew`` document with >=80% of the injected
+      seconds in ``straggler_wait_s``, charged to rank 1 in
+      ``wait_by_laggard``, straggler wait dominating wire, and the
+      persistent-laggard verdict naming rank 1 with a cause hypothesis;
+    - **the alert reaches the controller**: the sustained
+      ``skew_straggler_sustained`` rule latches exactly ONE episode
+      across repeated collector sweeps, and the firing arrives at an
+      ``ElasticController`` as a ``ctl.scale_signal``;
+    - **the A/A leg stays quiet**: the identical fence workload with no
+      chaos decomposes to ~0 straggler wait with ZERO alert episodes —
+      a healthy fleet never pages;
+    - **stamping is nearly free**: the per-step boundary stamp (the
+      only new work this lane adds to the hot step path — one bounded
+      ring append at ``step_span`` exit) costs <1% of a
+      training-representative step wall;
+    - **the render path works**: ``timeline --skew`` renders the
+      verdict from both the collector sink JSONL and a saved ``/skew``
+      document, and ``--follow`` emits the ``skew.run`` one-liner
+      naming the laggard.
+
+    The stamp cost is the drift-gated value
+    (``SPARKTORCH_TPU_SKEW_DRIFT_TOL`` vs the windowed median of prior
+    rounds).
+    """
+    import contextlib
+    import io
+    import os
+    import tempfile
+    import threading
+
+    import jax
+
+    from sparktorch_tpu.ctl.elastic import ElasticController
+    from sparktorch_tpu.ft import ChaosConfig, inject
+    from sparktorch_tpu.ft import chaos as _chaos
+    from sparktorch_tpu.native.gang import GangMetricsExporter
+    from sparktorch_tpu.obs import FleetCollector, Telemetry
+    from sparktorch_tpu.obs import goodput as _goodput
+    from sparktorch_tpu.obs import skew as _skew
+    from sparktorch_tpu.obs import timeline as _timeline
+    from sparktorch_tpu.obs.collector import scrape_json
+
+    t_start = time.perf_counter()
+    workdir = tempfile.mkdtemp(prefix="bench_skew_")
+    injected_total = delay_s * (steps - from_step)
+
+    def _fleet_leg(tag: str, chaos_cfg):
+        """One 2-rank fence workload scraped through a collector with
+        the skew rules armed and an ElasticController subscribed:
+        returns (run_doc, latched episodes, scale signals, sink path).
+
+        The rank threads stamp the exact shape the trainers do — chaos
+        fires BEFORE the step span (a real straggler is late INTO the
+        fence), the fence wait rides a nested exposed_comm span inside
+        ``step_span`` (so the victim's wait is in the merged
+        exposed_comm budget the decomposition splits)."""
+        teles = [Telemetry(run_id=f"bench_skew_{tag}") for _ in range(2)]
+        leds = [_goodput.GoodputLedger(telemetry=teles[r], rank=r)
+                for r in range(2)]
+        barrier = threading.Barrier(2)
+        errs: list = []
+
+        def rank_fn(r):
+            try:
+                led = leds[r]
+                for i in range(steps):
+                    _chaos.straggle(r, i)
+                    with led.step_span(step=i):
+                        with led.span("exposed_comm"):
+                            barrier.wait()
+                led.close()
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=rank_fn, args=(r,))
+                   for r in range(2)]
+        cm = (inject(chaos_cfg, telemetry=teles[0]) if chaos_cfg
+              else contextlib.nullcontext())
+        with cm:
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        if errs:
+            raise AssertionError(f"{tag} rank thread died: {errs[0]!r}")
+
+        exps = [GangMetricsExporter(telemetry=teles[r], port=0).start()
+                for r in range(2)]
+        sink = os.path.join(workdir, f"sink_{tag}.jsonl")
+        collector = FleetCollector(
+            {r: exps[r].url for r in range(2)}, poll_interval_s=0,
+            jsonl_path=sink, alert_rules=_skew.skew_alert_rules())
+        ctl = ElasticController([], lambda w: True,
+                                telemetry=collector.telemetry,
+                                alerts=collector.alerts)
+        collector.start(poll_loop=False)
+        try:
+            # The sustained rule wants for_sweeps consecutive breaches;
+            # one extra sweep proves the latch holds at ONE episode.
+            for _ in range(4):
+                collector.poll()
+            run_doc = scrape_json(f"{collector.url}/skew")
+        finally:
+            collector.stop()
+            for e in exps:
+                e.stop()
+            ctl.detach_alerts()
+        state = collector.alerts.doc()["rules"]["skew_straggler_sustained"]
+        return run_doc, int(state["episodes"]), list(ctl.scale_signals), sink
+
+    # -- leg 1: A/A — identical fence, no chaos, must stay quiet -------
+    aa_run, aa_eps, aa_signals, _aa_sink = _fleet_leg("aa", None)
+    aa_wait = float(aa_run.get("straggler_wait_s") or 0.0)
+    if aa_wait > 0.1 * injected_total:
+        raise AssertionError(
+            f"A/A leg shows {aa_wait:.3f}s straggler wait (injected "
+            f"nothing; bound {0.1 * injected_total:.3f}s) — the "
+            f"decomposition charges healthy fence jitter as straggling")
+    if aa_eps or aa_signals:
+        raise AssertionError(
+            f"A/A leg paged: {aa_eps} alert episode(s), "
+            f"{len(aa_signals)} scale signal(s) — false positives")
+
+    # -- leg 2: seeded straggler on rank 1 -----------------------------
+    chaos_run, chaos_eps, chaos_signals, chaos_sink = _fleet_leg(
+        "chaos", ChaosConfig(slow_rank_s={1: (from_step, delay_s)}))
+    wait = float(chaos_run.get("straggler_wait_s") or 0.0)
+    if wait < 0.8 * injected_total:
+        raise AssertionError(
+            f"injected {injected_total:.2f}s of straggling but only "
+            f"{wait:.3f}s landed in straggler_wait_s (<80%) — the "
+            f"decomposition is leaking the wait into wire time")
+    wire = chaos_run.get("wire_s")
+    if wire is None or wait <= float(wire):
+        raise AssertionError(
+            f"straggler wait {wait:.3f}s does not dominate wire "
+            f"{wire} — exposed_comm was not split")
+    to_r1 = float((chaos_run.get("wait_by_laggard") or {}).get("1") or 0.0)
+    if to_r1 < 0.8 * injected_total:
+        raise AssertionError(
+            f"only {to_r1:.3f}s of the {injected_total:.2f}s injected "
+            f"wait is charged to rank 1: "
+            f"{chaos_run.get('wait_by_laggard')}")
+    lag = chaos_run.get("laggard") or {}
+    if lag.get("rank") != "1" or not lag.get("persistent") \
+            or not lag.get("cause"):
+        raise AssertionError(
+            f"verdict did not name rank 1 as a persistent straggler "
+            f"with a cause hypothesis: {lag}")
+
+    # -- leg 3: latched alert -> controller scale signal ---------------
+    if chaos_eps != 1:
+        raise AssertionError(
+            f"want exactly one latched skew_straggler_sustained "
+            f"episode over 4 sweeps, got {chaos_eps}")
+    if not any(s.get("rule") == "skew_straggler_sustained"
+               for s in chaos_signals):
+        raise AssertionError(
+            f"the latched firing never reached the ElasticController "
+            f"as a ctl.scale_signal: {chaos_signals}")
+
+    # -- leg 4: timeline renders from sink + saved doc, follow line ----
+    saved = os.path.join(workdir, "skew.json")
+    with open(saved, "w") as f:
+        f.write(json.dumps(chaos_run))
+    for args_, what in ((["--skew", chaos_sink], "collector sink"),
+                        (["--skew", saved], "saved /skew doc")):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = _timeline.main(args_)
+        out_txt = buf.getvalue()
+        if rc != 0 or "step skew" not in out_txt \
+                or "persistent straggler" not in out_txt:
+            raise AssertionError(
+                f"timeline --skew ({what}) failed (rc={rc}) or lost "
+                f"the verdict:\n{out_txt[:800]}")
+    stop_ev = threading.Event()
+    stop_ev.set()
+    follow_lines = list(_timeline.follow(chaos_sink, poll_s=0.0,
+                                         stop=stop_ev))
+    if not any("skew.run" in ln and "laggard=rank 1" in ln
+               for ln in follow_lines):
+        raise AssertionError(
+            f"--follow tail lacks the skew.run one-liner:\n"
+            + "\n".join(follow_lines[:10]))
+
+    # -- stamp microbench (the drift-gated value) ----------------------
+    # The ONLY work this lane adds to the hot step path: one bounded
+    # ring append at step_span exit (the enter/exit perf_counter reads
+    # already existed for the goodput bucket). Quote it against a
+    # training-representative step wall, same discipline as
+    # bench_health: the fence microbench above is all-wait, so its
+    # wall is not a denominator any trainer would recognize.
+    led_ub = _goodput.GoodputLedger(
+        telemetry=Telemetry(run_id="bench_skew_ub"), rank="ub")
+    t0 = time.perf_counter()
+    for i in range(stamp_iters):
+        led_ub.skew.record(i, 1, 0.0, 1.0)
+    stamp_us = (time.perf_counter() - t0) / stamp_iters * 1e6
+
+    m = 768
+    rep = jax.jit(lambda a: (a @ a) @ (a @ a) * (1.0 / m))
+    xm = np.ones((m, m), np.float32)
+    rep(xm).block_until_ready()  # compile outside the measurement
+    rep_walls = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        rep(xm).block_until_ready()
+        rep_walls.append(time.perf_counter() - t0)
+    step_wall = min(rep_walls)
+    stamp_frac = (stamp_us * 1e-6) / max(step_wall, 1e-9)
+    if stamp_frac >= 0.01:
+        raise AssertionError(
+            f"step stamp costs {stamp_us:.2f}us — "
+            f"{100 * stamp_frac:.3f}% of the {step_wall * 1e3:.3f}ms "
+            f"representative step wall (>=1%)")
+
+    tol = float(os.environ.get("SPARKTORCH_TPU_SKEW_DRIFT_TOL", "0.5"))
+    prior = _prior_window("skew", "stamp_us", k=3)
+    if prior is None:
+        drift = {"status": "no_prior_record", "tolerance": tol}
+    else:
+        drift = {"status": "ok", "tolerance": tol, "prior": prior,
+                 "value": round(stamp_us, 3)}
+        if stamp_us > prior["median"] * (1.0 + tol) + 2.0:
+            drift["status"] = "regressed"
+            raise AssertionError(
+                f"step stamp cost regressed: {stamp_us:.2f}us vs prior "
+                f"windowed median {prior['median']:.2f}us (past the "
+                f"{tol} relative tolerance + 2us floor); drift: {drift}")
+
+    return {
+        "config": "skew", "unit": "us (step stamp cost)",
+        "value": round(stamp_us, 3),
+        "stamp_us": round(stamp_us, 3),
+        "stamp_pct_of_step": round(100 * stamp_frac, 4),
+        "step_wall_ms": round(step_wall * 1e3, 3),
+        "decomposition": {
+            "injected_s": round(injected_total, 3),
+            "straggler_wait_s": round(wait, 3),
+            "wire_s": round(float(wire), 3),
+            "straggler_fraction": chaos_run.get("straggler_fraction"),
+            "attributed_to_rank1_s": round(to_r1, 3),
+            "laggard": {"rank": lag.get("rank"),
+                        "persistent": bool(lag.get("persistent")),
+                        "cause": lag.get("cause")},
+        },
+        "aa": {"straggler_wait_s": round(aa_wait, 6), "episodes": 0,
+               "scale_signals": 0},
+        "alerts": {"episodes": 1, "scale_signals": len(chaos_signals)},
+        "skew_drift": drift,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+    }
+
+
 def _bert_flops_accounting(module, batch: int, seq: int) -> dict:
     """Honest model-FLOPs accounting for the BERT classifier.
 
@@ -5354,6 +5617,7 @@ CONFIGS: Dict[str, Callable[[], dict]] = {
     "goodput": bench_goodput,
     "profile": bench_profile,
     "health": bench_health,
+    "skew": bench_skew,
     "hogwild_ps_fleet": bench_hogwild_ps_fleet,
     "serve_online": bench_serve_online,
     "rpc_trace": bench_rpc_trace,

@@ -32,6 +32,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, List, Mapping, Optional
 
 
@@ -103,6 +104,15 @@ class ChaosConfig:
     # the drill needs exactly one bad step, then clean recovery
     # steps for the detectors/alerts to resolve against.
     poison_batch_at: Mapping[int, int] = dataclasses.field(
+        default_factory=dict)
+    # rank -> (from_step, delay_s): make that TRAIN rank a straggler —
+    # the 'train.rank' site (fired inside the step loop, before the
+    # step's collective fence) returns {"delay": delay_s} on EVERY
+    # step >= from_step, so the rank arrives late at the fence and its
+    # peers' exposed waits are attributable to it. Persistent, not
+    # one-shot: the skew referee's sustained straggler-fraction rule
+    # exists precisely for a rank that stays slow.
+    slow_rank_s: Mapping[int, Any] = dataclasses.field(
         default_factory=dict)
     # rank -> step: deliver a raw SIGKILL to that rank's PROCESS
     # worker once its heartbeat reports reaching the step — the
@@ -236,6 +246,22 @@ class ChaosInjector:
                     self._poisons_fired.add(worker)
                     self._record(site, **ctx)
                 return {"poison": True}
+        elif site == "train.rank":
+            # Straggler injection: the trainer sleeps {"delay": s}
+            # before its step span / collective fence, so the delay is
+            # visible to the cross-rank skew referee as a late arrival
+            # (never hidden inside the victim's own measured step).
+            rank = ctx.get("rank")
+            spec = next((v for k, v in cfg.slow_rank_s.items()
+                         if str(k) == str(rank)), None)
+            if spec is not None:
+                from_step, delay = int(spec[0]), float(spec[1])
+                step = ctx.get("step")
+                if delay > 0 and step is not None and step >= from_step:
+                    with self._lock:
+                        self._record(site, rank=rank, step=step,
+                                     delay_s=delay)
+                    return {"delay": delay}
         elif site == "ctl.process":
             # Non-cooperative process kill: the handle's liveness poll
             # asks "should this rank die NOW?" with the step its
@@ -312,6 +338,22 @@ def fire(site: str, **ctx: Any) -> Optional[Dict[str, Any]]:
     if inj is None:
         return None
     return inj.fire(site, **ctx)
+
+
+def straggle(rank: Any, step: int) -> float:
+    """The 'train.rank' injection point, packaged: fire the site and
+    sleep any injected straggler delay. Trainers call this inside the
+    step loop BEFORE the step span / collective fence, so the delay
+    shows up to the cross-rank skew referee as a late fence arrival
+    (the laggard's unattributed time), never as inflated step compute.
+    Returns the seconds slept (0.0 when chaos is off — one global
+    read, like every other site)."""
+    act = fire("train.rank", rank=rank, step=step)
+    if act and act.get("delay"):
+        delay = float(act["delay"])
+        time.sleep(delay)
+        return delay
+    return 0.0
 
 
 def poison_batch(batch: Any) -> Any:

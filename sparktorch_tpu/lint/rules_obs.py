@@ -190,9 +190,14 @@ class AsyncFetchRule(Rule):
 
     SYNC_CALLS = ("jax.device_get", "jax.block_until_ready")
     SPAN_ATTRS = ("span", "step_span")
+    # obs/skew.py is stamp-scope (see SPK201.STAMP_SCOPES): it merges
+    # ledger stamps that were captured asynchronously, so a device sync
+    # there would put wall time on the merge path of every scrape.
+    EXTRA_SCOPES = ("obs/skew.py",)
 
     def applies(self, rel: Optional[str]) -> bool:
-        return rel is None or rel.startswith("train/")
+        return (rel is None or rel.startswith("train/")
+                or rel.startswith(self.EXTRA_SCOPES))
 
     def _in_ledger_span(self, ctx: FileContext, node: ast.AST) -> bool:
         for anc in ctx.index.parent_chain(node):

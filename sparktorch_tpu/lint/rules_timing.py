@@ -39,18 +39,46 @@ class TimingLedgerRule(Rule):
     # goodput ledger owns time attribution.
     LEDGER_SCOPES = ("train/", "ctl/", "parallel/", "serve/")
 
+    # Stamp scope: modules that derive cross-rank step-boundary stamps
+    # from the ledger's span clock. Here BOTH clocks are banned — a
+    # local clock read would create a second time base that cannot be
+    # aligned across ranks (the skew merge subtracts stamps from
+    # different hosts; only ledger-anchored stamps share an epoch).
+    STAMP_SCOPES = ("obs/skew.py",)
+
     def run(self, ctx: FileContext) -> Iterator[Finding]:
         rel = ctx.rel
-        in_obs = rel is not None and rel.startswith("obs/")
+        in_stamp_scope = rel is not None and rel.startswith(self.STAMP_SCOPES)
+        in_obs = (rel is not None and rel.startswith("obs/")
+                  and not in_stamp_scope)
         in_ledger_scope = rel is None or rel.startswith(self.LEDGER_SCOPES)
         for node in ctx.index.calls:
             name = ctx.index.resolve(node.func)
             if name == "time.time" and not in_obs:
+                if in_stamp_scope:
+                    yield self.finding(
+                        ctx, node,
+                        "raw time.time() in a stamp-scope module: skew "
+                        "step-boundary stamps must come from the "
+                        "ledger's span clock (GoodputLedger stamps "
+                        "inside step_span; obs/skew.py only does "
+                        "arithmetic over them), or annotate "
+                        "`# lint-obs: ok (<why>)`")
+                else:
+                    yield self.finding(
+                        ctx, node,
+                        "raw time.time(): durations must use "
+                        "time.perf_counter(); wall-clock timestamps go "
+                        "through obs.telemetry.wall_ts(), or annotate "
+                        "`# lint-obs: ok (<why>)`")
+            elif name == "time.perf_counter" and in_stamp_scope:
                 yield self.finding(
                     ctx, node,
-                    "raw time.time(): durations must use "
-                    "time.perf_counter(); wall-clock timestamps go "
-                    "through obs.telemetry.wall_ts(), or annotate "
+                    "raw perf_counter in a stamp-scope module: skew "
+                    "step-boundary stamps must come from the ledger's "
+                    "span clock (LedgerSpan captures enter/exit once "
+                    "inside step_span) — a second clock read here "
+                    "cannot be aligned across ranks; annotate "
                     "`# lint-obs: ok (<why>)`")
             elif name == "time.perf_counter" and in_ledger_scope:
                 yield self.finding(

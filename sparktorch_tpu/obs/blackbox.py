@@ -297,6 +297,26 @@ def collect_postmortem(out_dir: str, reason: str,
             section = telemetry.get_section(_health_mod.SECTION)
             if isinstance(section, Mapping):
                 health = _health_mod.merge_sections({"local": section})
+    # "Skew at death": the run's final cross-rank straggler verdict —
+    # whether the dying run's exposed_comm was wire or one slow rank,
+    # and which. Same source order; a bare single-rank section still
+    # merges (no alignment, but the stamp accounting survives).
+    skew = None
+    if collector is not None:
+        try:
+            skew = collector.skew_view()
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            skew = None
+    if skew is None and telemetry is not None:
+        from sparktorch_tpu.obs import skew as _skew_mod
+
+        section = telemetry.get_section(_skew_mod.RUN_SECTION)
+        if isinstance(section, Mapping):
+            skew = dict(section)
+        else:
+            section = telemetry.get_section(_skew_mod.SECTION)
+            if isinstance(section, Mapping):
+                skew = _skew_mod.merge_sections({"local": section})
     # Dedup (the controller's history events also flow through its
     # bus recorder) and order: identical (ts, kind, rank) triples
     # collapse, the narrative reads in time order. The controller's
@@ -334,6 +354,7 @@ def collect_postmortem(out_dir: str, reason: str,
         "goodput": goodput,
         "profile": profile,
         "health": health,
+        "skew": skew,
         "rpc_traces": rpc_traces,
         "heartbeats": heartbeats,
         "world": world,

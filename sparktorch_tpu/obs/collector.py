@@ -522,11 +522,29 @@ class FleetCollector:
                         "counts": health_doc.get("counts"),
                         "worst": health_doc.get("worst"),
                     })
+                # The cross-rank straggler verdict: one condensed
+                # `skew.run` line per sweep (the wire/straggler split
+                # plus the named laggard — the `--follow` one-liner),
+                # full doc on the snapshot's sections.
+                skew_records: List[Dict[str, Any]] = []
+                skew_doc = (merged.get("sections") or {}).get("skew_run")
+                if isinstance(skew_doc, Mapping):
+                    skew_records.append({
+                        "kind": "skew.run", "ts": merged.get("ts"),
+                        "n_ranks": skew_doc.get("n_ranks"),
+                        "steps_aligned": skew_doc.get("steps_aligned"),
+                        "wire_s": skew_doc.get("wire_s"),
+                        "straggler_wait_s": skew_doc.get(
+                            "straggler_wait_s"),
+                        "straggler_fraction": skew_doc.get(
+                            "straggler_fraction"),
+                        "laggard": skew_doc.get("laggard"),
+                    })
                 write_jsonl(self.jsonl_path,
                             [{"kind": f"alert.{e['event']}", **e}
                              for e in alert_events]
                             + goodput_records + profile_records
-                            + health_records
+                            + health_records + skew_records
                             + [{"kind": "gang_snapshot", **merged,
                                 "heartbeats": self._merged_heartbeats()}],
                             append=True)
@@ -620,6 +638,12 @@ class FleetCollector:
         a dead rank's final ledger keeps contributing."""
         from sparktorch_tpu.obs import goodput as _goodput
 
+        from sparktorch_tpu.obs import skew as _skew
+
+        # The skew merge runs FIRST: it decomposes exposed_comm from
+        # the same per-rank sections, and the fresh skew_run verdict
+        # refines this merge's biggest_thief (straggler_wait vs wire).
+        self._merge_skew()
         with self._lock:
             snaps = {r: st.snapshot for r, st in self._ranks.items()}
         docs = _goodput.sections_from_snapshots(snaps)
@@ -628,7 +652,9 @@ class FleetCollector:
             docs.setdefault("collector", own)
         if not docs:
             return
-        run = _goodput.merge_sections(docs)
+        skew_run = self.telemetry.get_section(_skew.RUN_SECTION)
+        run = _goodput.merge_sections(
+            docs, skew=skew_run if isinstance(skew_run, Mapping) else None)
         run["run_id"] = self.run_id
         self.telemetry.set_section(_goodput.RUN_SECTION, run)
 
@@ -642,6 +668,50 @@ class FleetCollector:
         from sparktorch_tpu.obs import goodput as _goodput
 
         doc = self.telemetry.get_section(_goodput.RUN_SECTION)
+        return dict(doc) if isinstance(doc, Mapping) else None
+
+    def _merge_skew(self) -> None:
+        """Align every scraped rank's ``skew`` step-stamp ring (plus
+        this collector's own bus's, when a driver-side ledger shares
+        it) into the run-level straggler verdict, published as the
+        ``skew_run`` section and exported as ``skew.*`` gauges (the
+        series the sustained straggler-fraction alert rule judges).
+        The per-rank goodput/health sections from the SAME snapshots
+        supply the exposed_comm budget and the laggard's cause
+        evidence. Last-good contract: a dead rank's final stamps keep
+        contributing."""
+        from sparktorch_tpu.obs import goodput as _goodput
+        from sparktorch_tpu.obs import health as _health
+        from sparktorch_tpu.obs import skew as _skew
+
+        with self._lock:
+            snaps = {r: st.snapshot for r, st in self._ranks.items()}
+        docs = _skew.sections_from_snapshots(snaps)
+        own = self.telemetry.get_section(_skew.SECTION)
+        if isinstance(own, Mapping):
+            docs.setdefault("collector", own)
+        if not docs:
+            return
+        gdocs = _goodput.sections_from_snapshots(snaps)
+        gown = self.telemetry.get_section(_goodput.SECTION)
+        if isinstance(gown, Mapping):
+            gdocs.setdefault("collector", gown)
+        hdocs = _health.sections_from_snapshots(snaps)
+        run = _skew.merge_sections(docs, goodput_docs=gdocs,
+                                   health_docs=hdocs)
+        run["run_id"] = self.run_id
+        self.telemetry.set_section(_skew.RUN_SECTION, run)
+        _skew.publish_run_gauges(self.telemetry, run)
+
+    def skew_view(self) -> Optional[Dict[str, Any]]:
+        """The run-level straggler verdict ``GET /skew`` serves —
+        recomputed from the freshest last-good snapshots at read
+        time, like :meth:`goodput_view`. None when no rank has
+        published step stamps."""
+        self._merge_skew()
+        from sparktorch_tpu.obs import skew as _skew
+
+        doc = self.telemetry.get_section(_skew.RUN_SECTION)
         return dict(doc) if isinstance(doc, Mapping) else None
 
     def _merge_profile(self) -> None:
@@ -1081,8 +1151,9 @@ class FleetCollector:
               poll_loop: bool = True) -> "FleetCollector":
         """Start the HTTP surface (``/gang``, ``/metrics``,
         ``/telemetry``, ``/history``, ``/goodput``, ``/profile``,
-        ``POST /ctl``) and — when ``poll_interval_s`` > 0 and
-        ``poll_loop`` — the background scrape loop."""
+        ``/health``, ``/skew``, ``POST /ctl``) and — when
+        ``poll_interval_s`` > 0 and ``poll_loop`` — the background
+        scrape loop."""
         if serve and self._httpd is None:
             from http.server import (
                 BaseHTTPRequestHandler,
@@ -1150,6 +1221,17 @@ class FleetCollector:
                             self._send(404, json.dumps(
                                 {"ok": False,
                                  "error": "no health ledger published "
+                                          "by any scraped rank"}).encode(),
+                                content_type="application/json")
+                        else:
+                            self._send(200, json.dumps(doc).encode(),
+                                       content_type="application/json")
+                    elif route == "/skew":
+                        doc = collector.skew_view()
+                        if doc is None:
+                            self._send(404, json.dumps(
+                                {"ok": False,
+                                 "error": "no skew stamps published "
                                           "by any scraped rank"}).encode(),
                                 content_type="application/json")
                         else:

@@ -78,6 +78,8 @@ import threading
 import time
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
+from sparktorch_tpu.obs.skew import SECTION as _SKEW_SECTION
+from sparktorch_tpu.obs.skew import StepSkewRing
 from sparktorch_tpu.obs.telemetry import Telemetry, wall_ts
 
 SECTION = "goodput"
@@ -179,13 +181,18 @@ class LedgerSpan:
     fused steps a chunk dispatched) feeds the ledger's step counter
     for ``step`` spans and the per-bucket event counts otherwise.
     ``rebucket()`` may re-aim an open span (a step call discovered to
-    be a compile once the jit cache-miss probe lands)."""
+    be a compile once the jit cache-miss probe lands).
 
-    __slots__ = ("ledger", "bucket", "labels", "count", "t0",
+    ``step`` (optional, step spans only) is the explicit step index
+    the span trains — the skew ring's alignment key across ranks;
+    when None the ledger's own step counter supplies it."""
+
+    __slots__ = ("ledger", "bucket", "labels", "count", "step", "t0",
                  "duration_s", "_child_s", "_closed")
 
     def __init__(self, ledger: Optional["GoodputLedger"], bucket: str,
-                 labels: Optional[Dict[str, Any]] = None):
+                 labels: Optional[Dict[str, Any]] = None,
+                 step: Optional[int] = None):
         if bucket not in _DIRECT_BUCKETS:
             raise ValueError(
                 f"bucket {bucket!r} not attributable (want one of "
@@ -194,6 +201,7 @@ class LedgerSpan:
         self.bucket = bucket
         self.labels = dict(labels or {})
         self.count = 1
+        self.step = step if step is None else int(step)
         self.t0 = 0.0
         self.duration_s: Optional[float] = None
         self._child_s = 0.0
@@ -222,7 +230,8 @@ class LedgerSpan:
         return self
 
     def __exit__(self, *exc) -> None:
-        dur = time.perf_counter() - self.t0
+        end = time.perf_counter()
+        dur = end - self.t0
         self.duration_s = dur
         self._closed = True
         stack: List[LedgerSpan] = getattr(_TLS, "stack", [])
@@ -236,6 +245,13 @@ class LedgerSpan:
             # wall lands in exactly one bucket.
             stack[-1]._child_s += dur
         if self.ledger is not None:
+            if self.bucket == "step":
+                # Step-boundary stamp for the cross-rank skew ring:
+                # the span's OWN clock pair (no new clock sites),
+                # recorded before _attribute so an implicit step
+                # index reads the pre-increment counter.
+                self.ledger._stamp_step(self.step, self.count,
+                                        self.t0, end)
             self.ledger._attribute(self.bucket,
                                    max(dur - self._child_s, 0.0),
                                    self.count)
@@ -253,7 +269,8 @@ class GoodputLedger:
                  publish_interval_s: float = 0.25,
                  flops_per_step: Optional[float] = None,
                  n_chips: int = 1,
-                 peak_tflops: float = V5E_BF16_PEAK_TFLOPS):
+                 peak_tflops: float = V5E_BF16_PEAK_TFLOPS,
+                 skew_capacity: int = 512):
         self.telemetry = telemetry
         self.rank = rank
         self.publish_interval_s = float(publish_interval_s)
@@ -268,6 +285,11 @@ class GoodputLedger:
         # threads would attribute ~N x wall and read as massive
         # over-attribution with goodput > 1.
         self.lanes = 1
+        # Per-step boundary stamps for the cross-rank straggler
+        # referee (obs/skew.py): step spans stamp their enter/exit
+        # here, converted to wall time through the ctor anchor pair
+        # below so stamps from different processes are comparable.
+        self.skew = StepSkewRing(skew_capacity)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self.started_ts = wall_ts()
@@ -292,11 +314,14 @@ class GoodputLedger:
              labels: Optional[Dict[str, Any]] = None) -> LedgerSpan:
         return LedgerSpan(self, bucket, labels)
 
-    def step_span(self) -> LedgerSpan:
+    def step_span(self, step: Optional[int] = None) -> LedgerSpan:
         """A train-step body: gross seconds split compute vs
         exposed_comm by the comm model at read time; ``count`` is the
-        number of (fused) steps the call trained."""
-        return LedgerSpan(self, "step")
+        number of (fused) steps the call trained. ``step`` pins the
+        skew ring's alignment key (trainers pass their loop index so
+        ranks agree on which step is which); None falls back to this
+        ledger's own step counter."""
+        return LedgerSpan(self, "step", step=step)
 
     def add(self, bucket: str, seconds: float, count: int = 1) -> None:
         """Direct attribution (no timing) — the downtime buckets'
@@ -305,6 +330,18 @@ class GoodputLedger:
         if bucket not in _DIRECT_BUCKETS:
             raise ValueError(f"bucket {bucket!r} not attributable")
         self._attribute(bucket, max(float(seconds), 0.0), count)
+
+    def _stamp_step(self, step: Optional[int], count: int,
+                    t0: float, t1: float) -> None:
+        """Record one step span's boundary pair into the skew ring.
+        ``t0``/``t1`` are the span's perf_counter reads; the ctor
+        anchor pair (``started_ts``/``_t0``) converts them to wall
+        time — pure arithmetic, zero new clock sites."""
+        if step is None:
+            with self._lock:
+                step = self._n_steps  # pre-increment: _attribute runs after
+        base = self.started_ts - self._t0
+        self.skew.record(int(step), count, base + t0, base + t1)
 
     def _attribute(self, bucket: str, seconds: float, count: int) -> None:
         with self._lock:
@@ -442,6 +479,15 @@ class GoodputLedger:
         if tele is None:
             return doc
         tele.set_section(SECTION, doc)
+        if len(self.skew):
+            # The skew section rides beside goodput only once a step
+            # has stamped — a server/ctl ledger with no step spans
+            # must not publish an empty ring (the collector's /skew
+            # stays 404 until a real stamp exists).
+            sdoc = self.skew.snapshot()
+            sdoc["rank"] = self.rank
+            sdoc["started_ts"] = self.started_ts
+            tele.set_section(_SKEW_SECTION, sdoc)
         labels = ({"rank": str(self.rank)}
                   if self.rank is not None else None)
         for b in BUCKETS:
@@ -536,8 +582,8 @@ def span(bucket: str, labels: Optional[Dict[str, Any]] = None
     return LedgerSpan(_ACTIVE, bucket, labels)
 
 
-def step_span() -> LedgerSpan:
-    return LedgerSpan(_ACTIVE, "step")
+def step_span(step: Optional[int] = None) -> LedgerSpan:
+    return LedgerSpan(_ACTIVE, "step", step=step)
 
 
 def add(bucket: str, seconds: float, count: int = 1) -> None:
@@ -576,7 +622,8 @@ def biggest_thief(doc: Mapping[str, Any],
     return ranked[0] if ranked else None
 
 
-def merge_sections(rank_docs: Mapping[Any, Mapping[str, Any]]
+def merge_sections(rank_docs: Mapping[Any, Mapping[str, Any]],
+                   skew: Optional[Mapping[str, Any]] = None
                    ) -> Dict[str, Any]:
     """Fold per-rank ``goodput`` sections into ONE run-level report —
     what ``GET /goodput`` serves. Bucket seconds SUM across ranks (a
@@ -584,7 +631,15 @@ def merge_sections(rank_docs: Mapping[Any, Mapping[str, Any]]
     idle), wall sums likewise, and the run goodput fraction is
     productive rank-seconds over total rank-seconds. MFU aggregates
     flops-weighted over the ranks that declared FLOPs. The per-rank
-    docs ride along so the timeline can render one bar per rank."""
+    docs ride along so the timeline can render one bar per rank.
+
+    ``skew`` (a merged ``skew_run`` doc from
+    :func:`sparktorch_tpu.obs.skew.merge_sections`, when the caller —
+    the collector — has one) refines ``biggest_thief``: when the
+    thief is ``exposed_comm`` and straggler wait dominates wire, the
+    thief is renamed ``straggler_wait`` with the laggard rank, so the
+    one number an operator acts on points at the slow rank instead of
+    the collective."""
     per_rank: Dict[str, Dict[str, Any]] = {}
     buckets = {b: 0.0 for b in BUCKETS}
     counts: Dict[str, int] = {}
@@ -643,6 +698,18 @@ def merge_sections(rank_docs: Mapping[Any, Mapping[str, Any]]
         run["biggest_thief"] = {"bucket": thief[0],
                                 "seconds": round(thief[1], 6),
                                 "fraction": round(thief[1] / denom, 6)}
+        if thief[0] == "exposed_comm" and isinstance(skew, Mapping):
+            straggler = float(skew.get("straggler_wait_s") or 0.0)
+            wire = float(skew.get("wire_s") or 0.0)
+            if straggler > wire and straggler > 0:
+                bt = run["biggest_thief"]
+                bt["bucket"] = "straggler_wait"
+                bt["of"] = "exposed_comm"
+                bt["seconds"] = round(straggler, 6)
+                bt["fraction"] = round(straggler / denom, 6)
+                lag = (skew.get("laggard") or {}).get("rank")
+                if lag is not None:
+                    bt["laggard"] = lag
     if flops_total > 0 and chip_seconds > 0:
         # Per-chip rate over the flops-declaring ranks' chip-seconds;
         # MFU against their AGGREGATE capacity (each rank's own chip

@@ -551,6 +551,116 @@ def _health_from_jsonl(records: List[Dict[str, Any]]
 
 
 # ---------------------------------------------------------------------------
+# Cross-rank step-skew rendering (arrival bars + straggler verdict)
+# ---------------------------------------------------------------------------
+
+
+def render_skew_report(doc: Dict[str, Any], top: int = 10) -> str:
+    """One terminal page from a run-level skew verdict (the
+    collector's ``GET /skew`` document): the wire vs straggler-wait
+    decomposition of exposed_comm, per-rank arrival bars (median
+    arrival lag, wait caused/suffered), the named persistent laggard
+    with its cause hypothesis, and a per-step arrival detail table."""
+    n_ranks = int(doc.get("n_ranks") or 0)
+    aligned = int(doc.get("steps_aligned") or 0)
+    lines = [
+        f"step skew: {n_ranks} ranks, {aligned} aligned steps"
+        + (f"   run: {doc['run_id']}" if doc.get("run_id") else ""),
+    ]
+    exposed = doc.get("exposed_comm_s")
+    wait = float(doc.get("straggler_wait_s") or 0.0)
+    if exposed is not None:
+        frac = float(doc.get("straggler_fraction") or 0.0)
+        lines.append(
+            f"exposed comm {float(exposed):.3f}s = "
+            f"wire {float(doc.get('wire_s') or 0.0):.3f}s + "
+            f"straggler wait {wait:.3f}s ({100 * frac:.1f}% straggler)")
+    else:
+        lines.append(
+            f"arrival wait {wait:.3f}s (no goodput budget scraped — "
+            f"wire split unavailable)")
+    lag = doc.get("laggard")
+    if isinstance(lag, dict) and lag.get("persistent"):
+        cause = lag.get("cause") or "unknown"
+        ev = "; ".join(lag.get("evidence") or [])
+        lines.append(
+            f"verdict: rank {lag.get('rank')} is a persistent "
+            f"straggler — caused {100 * float(lag.get('share') or 0):.1f}%"
+            f" of the wait over {lag.get('steps')} steps; "
+            f"cause hypothesis: {cause}" + (f" ({ev})" if ev else ""))
+    elif isinstance(lag, dict):
+        lines.append(
+            f"verdict: no persistent straggler (top laggard rank "
+            f"{lag.get('rank')} at {100 * float(lag.get('share') or 0):.1f}%"
+            f" of wait over {lag.get('steps')} step(s))")
+    elif aligned:
+        lines.append("verdict: no straggler wait observed")
+    else:
+        lines.append("verdict: no cross-rank alignment "
+                     "(need the same step stamped on >= 2 ranks)")
+    per_rank = doc.get("per_rank") or {}
+    if per_rank:
+        total_caused = sum(float((r or {}).get("wait_caused_s") or 0.0)
+                           for r in per_rank.values()) or 1.0
+        lines += ["", f"{'rank':>10} {'steps':>6} {'lag p50':>9} "
+                      f"{'lag max':>9} {'caused':>9} {'suffered':>9}"
+                      f"  wait share"]
+
+        def _rank_key(item):
+            try:
+                return (0, int(item[0]))
+            except (TypeError, ValueError):
+                return (1, str(item[0]))
+
+        for rank, rdoc in sorted(per_rank.items(), key=_rank_key):
+            caused = float(rdoc.get("wait_caused_s") or 0.0)
+            bar = "#" * int(round(_BAR_W / 2 * caused / total_caused))
+            lines.append(
+                f"{str(rank):>10} {rdoc.get('steps', 0):>6}"
+                f" {_fmt_ms(float(rdoc.get('arrival_lag_p50_s') or 0)):>9}"
+                f" {_fmt_ms(float(rdoc.get('arrival_lag_max_s') or 0)):>9}"
+                f" {caused:>8.3f}s"
+                f" {float(rdoc.get('wait_suffered_s') or 0.0):>8.3f}s"
+                f"  {bar}")
+    per_step = doc.get("per_step") or []
+    if per_step:
+        shown = per_step[-top:]
+        lines += ["", f"per-step arrivals (last {len(shown)}; "
+                      f"offset from first arrival):"]
+        for entry in shown:
+            arrivals = entry.get("arrivals") or {}
+            arr = "  ".join(
+                f"{r}+{_fmt_ms(float(arrivals[r]))}"
+                for r in sorted(arrivals, key=str))
+            lines.append(
+                f"  step {entry.get('step'):>6}"
+                f"  skew {_fmt_ms(float(entry.get('skew_s') or 0)):>9}"
+                f"  laggard {str(entry.get('laggard')):<6} {arr}")
+    return "\n".join(lines) + "\n"
+
+
+def _skew_from_jsonl(records: List[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    """The newest skew verdict in a JSONL file: a collector sink/dump
+    record carrying the merged ``skew_run`` section wins; a bare rank
+    dump's ``skew`` section is merged to the same shape (no alignment
+    from one rank, but the stamp accounting renders)."""
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        doc = sections.get("skew_run")
+        if isinstance(doc, dict) and doc.get("per_rank"):
+            return doc
+    from sparktorch_tpu.obs import skew as _skew
+
+    for rec in reversed(records):
+        sections = rec.get("sections") or {}
+        sec = sections.get("skew")
+        if isinstance(sec, dict) and sec.get("stamps"):
+            return _skew.merge_sections({"dump": sec})
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Stack-profile rendering (per-bucket top-down trees)
 # ---------------------------------------------------------------------------
 
@@ -794,6 +904,28 @@ def render_postmortem_report(doc: Dict[str, Any], top: int = 40) -> str:
             lines.append(
                 f"  step {a.get('step'):>6}  rank {a.get('rank')!s:<6}"
                 f" {a.get('akind')} value={a.get('value')}")
+    sdoc = doc.get("skew")
+    if isinstance(sdoc, dict) and (sdoc.get("steps_aligned")
+                                   or sdoc.get("per_rank")):
+        wait = float(sdoc.get("straggler_wait_s") or 0.0)
+        exposed = sdoc.get("exposed_comm_s")
+        lines.append("")
+        lines.append(
+            f"step skew at death: "
+            f"{sdoc.get('steps_aligned', 0)} aligned steps, "
+            f"straggler wait {wait:.3f}s"
+            + (f" of {float(exposed):.3f}s exposed comm "
+               f"(wire {float(sdoc.get('wire_s') or 0.0):.3f}s)"
+               if exposed is not None else ""))
+        lag = sdoc.get("laggard")
+        if isinstance(lag, dict):
+            lines.append(
+                f"  laggard: rank {lag.get('rank')} "
+                f"({100 * float(lag.get('share') or 0):.1f}% of wait, "
+                f"{lag.get('steps')} steps"
+                + (f", cause: {lag.get('cause')}"
+                   if lag.get("persistent") else ", not persistent")
+                + ")")
     traces = doc.get("rpc_traces") or []
     if traces:
         lines.append("")
@@ -865,7 +997,7 @@ class FollowReader:
 # Record kinds --follow renders (everything else is metric volume the
 # tail mode exists to cut through). "span" is deliberately absent.
 _FOLLOW_PREFIXES = ("alert.", "ctl.", "ft_", "chaos", "gang_snapshot",
-                    "goodput", "profile", "health")
+                    "goodput", "profile", "health", "skew")
 
 
 def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
@@ -924,6 +1056,23 @@ def render_follow_line(rec: Dict[str, Any]) -> Optional[str]:
                    f"@step{worst.get('step')}"
                    f" rank={worst.get('rank')}"
                    if worst else ""))
+    if kind == "skew.run":
+        # The collector's condensed straggler record: one line says
+        # whether exposed comm is wire or waiting, and for whom.
+        lag = rec.get("laggard") or {}
+        frac = rec.get("straggler_fraction")
+        return (f"{stamp}  {kind:<18} "
+                f" ranks={rec.get('n_ranks')}"
+                f" steps={rec.get('steps_aligned')}"
+                + (f" wire={float(rec.get('wire_s') or 0.0):.2f}s"
+                   if rec.get("wire_s") is not None else "")
+                + f" straggler={float(rec.get('straggler_wait_s') or 0.0):.2f}s"
+                + (f" ({100 * float(frac):.0f}%)"
+                   if frac is not None else "")
+                + (f" laggard=rank {lag.get('rank')}"
+                   + (f" cause={lag.get('cause')}"
+                      if lag.get("cause") else "")
+                   if lag else ""))
     who = ""
     if rec.get("rank") is not None:
         who = f" rank={rec['rank']}"
@@ -1177,6 +1326,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "health_run/health section): per-rank "
                              "loss/grad-norm sparklines, rank-tagged "
                              "anomaly log, worst anomaly named")
+    parser.add_argument("--skew", action="store_true",
+                        help="render the cross-rank step-skew verdict "
+                             "(a saved GET /skew document, or a "
+                             "collector/telemetry .jsonl carrying the "
+                             "skew_run/skew section): wire vs "
+                             "straggler-wait split, per-rank arrival "
+                             "bars, persistent laggard named with a "
+                             "cause hypothesis")
     parser.add_argument("--diff", metavar="PRIOR", default=None,
                         help="with --profile: compare against a prior "
                              "profile document/JSONL and render the "
@@ -1196,10 +1353,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.top = 40 if args.postmortem else 10
 
     if sum((args.gang, args.tune, args.rpc, args.postmortem,
-            args.follow, args.goodput, args.profile, args.health)) > 1:
+            args.follow, args.goodput, args.profile, args.health,
+            args.skew)) > 1:
         print("error: --gang, --tune, --rpc, --postmortem, --follow, "
-              "--goodput, --profile and --health are different reports; "
-              "pick one")
+              "--goodput, --profile, --health and --skew are different "
+              "reports; pick one")
         return 2
     if args.diff is not None and not args.profile:
         print("error: --diff goes with --profile")
@@ -1208,6 +1366,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _main_profile(args)
     if args.health:
         return _main_health(args)
+    if args.skew:
+        return _main_skew(args)
     if args.goodput:
         return _main_goodput(args)
     if args.tune:
@@ -1360,6 +1520,44 @@ def _main_health(args) -> int:
             return 1
     print(json.dumps(doc) if args.json
           else render_health_report(doc, top=args.top),
+          end="" if not args.json else "\n")
+    return 0
+
+
+def _main_skew(args) -> int:
+    """--skew: a saved /skew JSON document, or a JSONL whose newest
+    record carries the skew_run (collector) / skew (single rank)
+    section."""
+    if len(args.paths) > 1:
+        print("error: --skew renders one file at a time")
+        return 2
+    path = args.paths[0]
+    if _looks_like_jsonl(path):
+        from sparktorch_tpu.obs.sinks import read_jsonl
+
+        try:
+            records = read_jsonl(path)
+        except OSError as e:
+            print(f"error: {e}")
+            return 1
+        doc = _skew_from_jsonl(records)
+        if doc is None:
+            print(f"no step-skew verdict (sections.skew_run / "
+                  f"sections.skew) in {path}")
+            return 1
+    else:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}")
+            return 1
+        if not isinstance(doc, dict) or doc.get("kind") != "skew_run":
+            print(f"error: {path} is not a skew document "
+                  f"(kind != 'skew_run')")
+            return 1
+    print(json.dumps(doc) if args.json
+          else render_skew_report(doc, top=args.top),
           end="" if not args.json else "\n")
     return 0
 

@@ -403,6 +403,10 @@ def _worker_loop(
             _act = _chaos.fire("data.batch", worker=worker_id, step=it)
             if _act and _act.get("poison"):
                 shard = _chaos.poison_batch(shard)
+            # Straggler injection before the pull (this loop's wire
+            # fence) and the step span: the skew referee sees a late
+            # arrival on this worker.
+            _chaos.straggle(worker_id, it)
             # Wire waits are EXPOSED comm by definition (nothing
             # overlaps them in this loop); the pulled params' host->
             # device upload is a data wait. Both ride LedgerSpans so
@@ -424,7 +428,7 @@ def _worker_loop(
             # end-of-loop drain): the step span here counts steps and
             # catches the dispatch wall; the real device seconds land
             # in compute via the materialize/drain attributions below.
-            with _goodput.step_span() as _led:
+            with _goodput.step_span(step=it) as _led:
                 with step_annotation(it, telemetry=tele):
                     if window_k > 1 and grad_windows is not None:
                         fn = (grad_windows[0] if k == window_k

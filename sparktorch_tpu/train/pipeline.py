@@ -2841,6 +2841,9 @@ def train_distributed_pipeline(
                                    worker=jax.process_index(), step=i)
                 if _act and _act.get("poison"):
                     batch = _chaos.poison_batch(batch)
+                # Straggler injection before the step span: a late
+                # fence arrival the skew referee can attribute.
+                _chaos.straggle(jax.process_index(), i)
                 sample_key, sub = jax.random.split(sample_key)
                 # Goodput step clock: dispatch + loss materialization
                 # timed by a LedgerSpan (step_time_s comes off its
@@ -2849,7 +2852,7 @@ def train_distributed_pipeline(
                 # when the jitted's dispatch cache grew under it).
                 cache0 = (step.jit_cache_size()
                           if _goodput.active() is not None else None)
-                with _goodput.step_span() as _led:
+                with _goodput.step_span(step=i) as _led:
                     with tele.span("train_pp/step_call"), \
                             step_annotation(i, telemetry=tele):
                         state, out = step(state, batch, key=sub)

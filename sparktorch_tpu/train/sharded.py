@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparktorch_tpu.ft import chaos as _chaos
 from sparktorch_tpu.parallel.compat import set_mesh as _set_mesh
 from sparktorch_tpu.parallel.mesh import AXIS_SP, BATCH_AXES, replicated
 from sparktorch_tpu.parallel.sharding_rules import shard_params, transformer_rules
@@ -275,10 +276,13 @@ def _make_auto_pipeline_step(spec, tx, mesh, tune_result, rng,
         # recompile lands on the TuneResult's compile bill).
         if est_comm_fraction is not None:
             ledger.set_comm_model(est_comm_fraction, "estimate")
+        # Straggler injection before the step span: the skew referee
+        # must see a late fence arrival, not a longer step.
+        _chaos.straggle(jax.process_index(), step_no)
         cache0 = step.jit_cache_size()
         with tele.span("train_sharded/step"), \
                 step_annotation(step_no, telemetry=tele):
-            with ledger.step_span() as led:
+            with ledger.step_span(step=step_no) as led:
                 out = step(state, batch)
                 cache1 = step.jit_cache_size()
                 if cache0 is not None and cache1 is not None \
@@ -567,10 +571,13 @@ def make_sharded_train_step(
         # winner recompile).
         if est_comm_fraction is not None:
             ledger.set_comm_model(est_comm_fraction, "estimate")
+        # Straggler injection before the step span (late fence
+        # arrival, attributable by the skew referee).
+        _chaos.straggle(jax.process_index(), step_no)
         cache0 = _goodput.jit_cache_size(jitted)
         with _set_mesh(mesh), tele.span("train_sharded/step"), \
                 step_annotation(step_no, telemetry=tele):
-            with ledger.step_span() as led:
+            with ledger.step_span(step=step_no) as led:
                 out = jitted(state, batch)
                 cache1 = _goodput.jit_cache_size(jitted)
                 if cache0 is not None and cache1 is not None \

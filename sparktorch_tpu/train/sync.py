@@ -400,6 +400,10 @@ def train_distributed(
                     train_batch = _chaos.poison_batch(train_batch)
                 if _hl is not None:
                     _hl.note_replay_anchor(state, train_batch)
+                # Seeded straggler injection: sleep BEFORE the step
+                # span so the skew referee sees a late fence arrival
+                # on this rank, not a longer step.
+                _chaos.straggle(jax.process_index(), i)
                 # The step clock is a goodput LedgerSpan: it times the
                 # dispatch+sync region whether or not a ledger is
                 # active (step_time_s comes off its duration), and when
@@ -410,7 +414,7 @@ def train_distributed(
                           if _goodput.active() is not None else None)
                 if steps_per_call > 1:
                     n = min(steps_per_call, iters - i)
-                    with _goodput.step_span() as _led:
+                    with _goodput.step_span(step=i) as _led:
                         with tele.span("train/step_chunk") as _chunk_span, \
                                 step_annotation(
                                     int(metrics[-1]["iter"]) + 1
@@ -465,7 +469,7 @@ def train_distributed(
                                                      vals, actives, drops)
                     ]
                 else:
-                    with _goodput.step_span() as _led:
+                    with _goodput.step_span(step=i) as _led:
                         with tele.span("train/step") as _step_span, \
                                 step_annotation(i, telemetry=tele):
                             state, step_metrics = train_step(state,
@@ -880,9 +884,12 @@ def train_distributed_streaming(
                     resident = _chaos.poison_batch(resident)
                 if _hl is not None:
                     _hl.note_replay_anchor(state, resident)
+                # Straggler injection before the step span: a late
+                # fence arrival, visible to the skew referee.
+                _chaos.straggle(jax.process_index(), it_counter)
                 cache0 = (_goodput.jit_cache_size(step_fn)
                           if _goodput.active() is not None else None)
-                with _goodput.step_span() as _led, \
+                with _goodput.step_span(step=it_counter) as _led, \
                         tele.span("train_streaming/chunk"):
                     state, metrics = step_fn(state, resident)
                     # Enqueue the NEXT chunk's host->device copy while

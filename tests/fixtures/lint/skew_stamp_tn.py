@@ -1,0 +1,27 @@
+"""TN fixture for the skew stamp scope: the sanctioned idioms.
+Stamps are ARITHMETIC over values the ledger already captured inside
+step_span (no clock read), device syncs sit inside ledger spans, and a
+genuine control-flow clock carries the suppression annotation.
+"""
+
+import time
+
+import jax
+
+
+def stamps_from_ledger(ledger, t0, t1):
+    # The only clock obs/skew.py needs: the anchor pair the ledger
+    # captured once at construction, applied as pure arithmetic.
+    base = ledger.started_ts - ledger._t0
+    return base + t0, base + t1
+
+
+def sync_inside_span(led, tracked):
+    with led.step_span(step=7):
+        with led.span("exposed_comm"):
+            return jax.device_get(tracked)
+
+
+def backoff_clock():
+    t0 = time.perf_counter()  # lint-obs: ok (control-flow backoff)
+    return t0

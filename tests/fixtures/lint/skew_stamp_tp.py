@@ -1,0 +1,22 @@
+"""TP fixture for the skew stamp scope (SPK201 + SPK108): raw clocks
+and device syncs that a stamp-scope module (obs/skew.py) must never
+contain. Step-boundary stamps come from the ledger's span clock —
+a local clock read here is a second time base that cannot be aligned
+across ranks — and the merge path must never sync the device.
+"""
+
+import time
+
+import jax
+
+
+def bad_stamp_pair(step):
+    enter = time.time()            # SPK201: raw wall clock
+    exit_ = time.perf_counter()    # SPK201: second time base
+    return step, enter, exit_
+
+
+def bad_merge_sync(tracked, out):
+    host = jax.device_get(tracked)     # SPK108: sync on the merge path
+    out.block_until_ready()            # SPK108: bare attribute sync
+    return host

@@ -52,6 +52,7 @@ TRUE_POSITIVES = {
                        "SPK104": 1, "SPK105": 1},
     "profiler_api_tp.py": {"SPK107": 3},
     "async_fetch_tp.py": {"SPK108": 4},
+    "skew_stamp_tp.py": {"SPK201": 2, "SPK108": 2},
 }
 
 TRUE_NEGATIVES = [
@@ -64,6 +65,7 @@ TRUE_NEGATIVES = [
     "obs_misc_tn.py",
     "profiler_api_tn.py",
     "async_fetch_tn.py",
+    "skew_stamp_tn.py",
     "suppressed_ok.py",
 ]
 
@@ -172,6 +174,38 @@ def test_loop_index_scoped_to_its_binding_loop(tmp_path):
     findings = lint_file(str(p), ALL_RULES)
     assert counts(findings) == {"SPK401": 1}
     assert findings[0].line == 5
+
+
+def test_skew_stamp_scope_pins():
+    """obs/skew.py is stamp-scope: BOTH clocks are banned there (the
+    module only does arithmetic over ledger-captured stamps) and SPK108
+    applies even though it is not under train/. Plain obs/ modules keep
+    their historical scoping — time.time exempt, perf_counter free."""
+    import ast as ast_mod
+
+    from sparktorch_tpu.lint.core import FileContext, ModuleIndex
+    from sparktorch_tpu.lint.rules_obs import AsyncFetchRule
+    from sparktorch_tpu.lint.rules_timing import TimingLedgerRule
+
+    src = ("import time\nimport jax\n"
+           "a = time.time()\n"
+           "b = time.perf_counter()\n"
+           "c = jax.device_get(a)\n")
+    tree = ast_mod.parse(src)
+
+    def ctx(rel):
+        return FileContext(path=rel, rel=rel, tree=tree,
+                           lines=src.splitlines(),
+                           index=ModuleIndex(tree))
+
+    timing, fetch = TimingLedgerRule(), AsyncFetchRule()
+    skew_findings = list(timing.run(ctx("obs/skew.py")))
+    assert len(skew_findings) == 2
+    assert all("span clock" in f.message for f in skew_findings)
+    assert fetch.applies("obs/skew.py")
+    assert len(list(fetch.run(ctx("obs/skew.py")))) == 1
+    assert list(timing.run(ctx("obs/goodput.py"))) == []
+    assert not fetch.applies("obs/goodput.py")
 
 
 def test_package_rel_scoping():
